@@ -1,0 +1,6 @@
+from .reader import (
+    PolyaxonfileError,
+    check_polyaxonfile,
+    read_polyaxonfile,
+    read_specs,
+)

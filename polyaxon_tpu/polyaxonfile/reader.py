@@ -1,0 +1,133 @@
+"""Polyaxonfile reading: YAML/JSON → validated V1Operation / V1Component.
+
+Reference parity: upstream `cli/polyaxon/_polyaxonfile/` (unverified,
+SURVEY.md §1 "Spec / schemas" row). Behaviors kept:
+- a file may hold a component or an operation; bare components are wrapped
+  into an operation so `polyaxon run -f component.yaml` works;
+- multi-document YAML streams yield multiple specs;
+- `-P name=value` CLI params override/extend operation params;
+- validation errors carry file + pydantic location context.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional, Union
+
+import yaml
+from pydantic import ValidationError
+
+from ..schemas import V1Component, V1Operation
+
+
+class PolyaxonfileError(Exception):
+    pass
+
+
+def _load_docs(path: Union[str, Path]) -> list[dict]:
+    p = Path(path)
+    if not p.exists():
+        raise PolyaxonfileError(f"polyaxonfile not found: {p}")
+    text = p.read_text()
+    try:
+        if p.suffix == ".json":
+            docs = [json.loads(text)]
+        else:
+            docs = [d for d in yaml.safe_load_all(text) if d is not None]
+    except (yaml.YAMLError, json.JSONDecodeError) as e:
+        raise PolyaxonfileError(f"polyaxonfile {p} is not valid YAML/JSON: {e}") from e
+    if not docs:
+        raise PolyaxonfileError(f"polyaxonfile is empty: {p}")
+    for d in docs:
+        if not isinstance(d, dict):
+            raise PolyaxonfileError(
+                f"polyaxonfile {p} must contain mappings, got {type(d).__name__}"
+            )
+    return docs
+
+
+def _validate_doc(doc: dict, source: str) -> Union[V1Component, V1Operation]:
+    kind = doc.get("kind")
+    try:
+        if kind == "component":
+            return V1Component.model_validate(doc)
+        if kind == "operation":
+            return V1Operation.model_validate(doc)
+    except ValidationError as e:
+        errs = "; ".join(
+            f"{'.'.join(str(x) for x in err['loc'])}: {err['msg']}" for err in e.errors()
+        )
+        raise PolyaxonfileError(f"{source}: invalid {kind}: {errs}") from e
+    raise PolyaxonfileError(
+        f"{source}: `kind` must be 'component' or 'operation', got {kind!r}"
+    )
+
+
+def wrap_component(component: V1Component) -> V1Operation:
+    return V1Operation(component=component, name=component.name)
+
+
+def read_specs(path: Union[str, Path]) -> list[V1Operation]:
+    """Read a polyaxonfile into a list of operations (components wrapped)."""
+    ops = []
+    for doc in _load_docs(path):
+        spec = _validate_doc(doc, str(path))
+        ops.append(wrap_component(spec) if isinstance(spec, V1Component) else spec)
+    return ops
+
+
+def parse_cli_param(raw: str) -> tuple[str, Any]:
+    """Parse `-P name=value`, YAML-decoding the value (so `-P lr=0.1` is a
+    float and `-P layers=[1,2]` a list)."""
+    if "=" not in raw:
+        raise PolyaxonfileError(f"bad param {raw!r}; expected name=value")
+    name, _, value = raw.partition("=")
+    try:
+        parsed = yaml.safe_load(value)
+    except yaml.YAMLError:
+        parsed = value
+    return name.strip(), parsed
+
+
+def read_polyaxonfile(
+    path: Union[str, Path],
+    params: Optional[dict[str, Any]] = None,
+    name: Optional[str] = None,
+) -> V1Operation:
+    """Read the first (or only) operation, applying CLI param overrides."""
+    ops = read_specs(path)
+    if len(ops) > 1:
+        raise PolyaxonfileError(
+            f"{path} holds {len(ops)} specs; pass one operation per run"
+        )
+    op = ops[0]
+    if params:
+        merged = dict(op.params or {})
+        from ..schemas.io import V1Param
+
+        for k, v in params.items():
+            merged[k] = V1Param(value=v)
+        op = op.model_copy(update={"params": merged})
+    if name:
+        op = op.model_copy(update={"name": name})
+    return op
+
+
+def check_polyaxonfile(path: Union[str, Path]) -> list[dict]:
+    """`polyaxon check`: validate and return summaries without running."""
+    out = []
+    for op in read_specs(path):
+        run_kind = None
+        if op.component is not None and op.component.run is not None:
+            run_kind = op.component.run.kind
+        out.append(
+            {
+                "name": op.name,
+                "kind": "operation",
+                "run_kind": run_kind,
+                "params": sorted((op.params or {}).keys()),
+                "matrix": getattr(op.matrix, "kind", None),
+            }
+        )
+    return out

@@ -1,0 +1,36 @@
+"""Base pydantic machinery for all Polyaxonfile schemas.
+
+Reference parity: the reference's spec layer (upstream `cli/polyaxon/_schemas/`,
+unverified — mount empty, see SURVEY.md §0) is pydantic-based with camelCase
+YAML surface (`hubRef`, `maxRetries`, ...). We keep that surface so stock
+Polyaxonfiles parse unmodified, while storing snake_case internally.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict
+
+
+def to_camel(s: str) -> str:
+    parts = s.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+class BaseSchema(BaseModel):
+    """Base for every V1* schema: camelCase aliases, round-trippable."""
+
+    model_config = ConfigDict(
+        populate_by_name=True,
+        alias_generator=to_camel,
+        extra="forbid",
+        validate_assignment=True,
+    )
+
+    def to_dict(self, *, by_alias: bool = True) -> dict[str, Any]:
+        return self.model_dump(by_alias=by_alias, exclude_none=True, mode="json")
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]):
+        return cls.model_validate(data)

@@ -1,0 +1,77 @@
+"""V1Component: the reusable unit of execution.
+
+Reference parity: upstream `V1Component` {version, kind, name, tags, inputs,
+outputs, run} (unverified, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import field_validator, model_validator
+
+from .base import BaseSchema
+from .environment import V1Environment
+from .io import V1IO
+from .run_kinds import V1RunKindField
+from .termination import V1Termination
+
+
+class V1Plugins(BaseSchema):
+    auth: Optional[bool] = None
+    docker: Optional[bool] = None
+    shm: Optional[bool] = None
+    collect_artifacts: Optional[bool] = None
+    collect_logs: Optional[bool] = None
+    collect_resources: Optional[bool] = None
+    sync_statuses: Optional[bool] = None
+    auto_resume: Optional[bool] = None
+    log_level: Optional[str] = None
+
+
+class V1Cache(BaseSchema):
+    disable: Optional[bool] = None
+    ttl: Optional[int] = None
+
+
+class V1Build(BaseSchema):
+    hub_ref: Optional[str] = None
+    connection: Optional[str] = None
+    params: Optional[dict[str, Any]] = None
+
+
+class V1Component(BaseSchema):
+    version: float | str = 1.1
+    kind: str = "component"
+    name: Optional[str] = None
+    description: Optional[str] = None
+    tags: Optional[list[str]] = None
+    presets: Optional[list[str]] = None
+    queue: Optional[str] = None
+    cache: Optional[V1Cache] = None
+    termination: Optional[V1Termination] = None
+    plugins: Optional[V1Plugins] = None
+    build: Optional[V1Build] = None
+    hooks: Optional[list[dict]] = None
+    inputs: Optional[list[V1IO]] = None
+    outputs: Optional[list[V1IO]] = None
+    run: V1RunKindField
+
+    @field_validator("kind")
+    @classmethod
+    def _kind(cls, v):
+        if v != "component":
+            raise ValueError(f"component kind must be 'component', got {v!r}")
+        return v
+
+    def get_input(self, name: str) -> Optional[V1IO]:
+        for io in self.inputs or []:
+            if io.name == name:
+                return io
+        return None
+
+    def get_output(self, name: str) -> Optional[V1IO]:
+        for io in self.outputs or []:
+            if io.name == name:
+                return io
+        return None

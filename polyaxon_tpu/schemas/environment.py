@@ -1,0 +1,121 @@
+"""Execution environment: resources (with the first-class `tpu:` block),
+node scheduling hints, labels.
+
+North star (BASELINE.json): `environment.resources` gains a `tpu:` block that
+replaces `nvidia.com/gpu` requests with TPU-slice topology. Reference parity:
+upstream `V1Environment` (unverified, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from pydantic import field_validator, model_validator
+
+from .base import BaseSchema
+
+# chips per topology unit for supported generations; used to derive chip count
+TPU_TYPES = {
+    "v4": {"cores_per_chip": 1, "max_topology": (4, 4, 4)},
+    "v5e": {"cores_per_chip": 1, "max_topology": (16, 16)},
+    "v5p": {"cores_per_chip": 1, "max_topology": (8, 8, 8)},
+    "v6e": {"cores_per_chip": 1, "max_topology": (16, 16)},
+}
+
+# chips per host for common generations (v5e: 4 chips/host standard pods)
+CHIPS_PER_HOST = {"v4": 4, "v5e": 4, "v5p": 4, "v6e": 4}
+
+
+class V1TpuSpec(BaseSchema):
+    """TPU slice request: `tpu: {type: v5e, topology: 4x8}`.
+
+    `topology` is an ICI grid like "2x4" or "4x4x4"; `count` may be given
+    instead for a 1-D slice. Used by the converter to pick node selectors
+    (`google.com/tpu`, `cloud.google.com/gke-tpu-topology`) and by the
+    parallel layer to build the device mesh (parallel/mesh.py).
+    """
+
+    type: str = "v5e"
+    topology: Optional[str] = None
+    count: Optional[int] = None
+    megacore: Optional[bool] = None
+
+    @field_validator("type")
+    @classmethod
+    def _check_type(cls, v: str) -> str:
+        if v not in TPU_TYPES:
+            raise ValueError(f"unknown TPU type {v!r}; one of {sorted(TPU_TYPES)}")
+        return v
+
+    @field_validator("topology")
+    @classmethod
+    def _check_topology(cls, v: Optional[str]) -> Optional[str]:
+        if v is None:
+            return v
+        dims = v.lower().split("x")
+        if not dims or not all(d.isdigit() and int(d) > 0 for d in dims):
+            raise ValueError(f"bad topology {v!r}; expected e.g. '4x8' or '4x4x4'")
+        return v.lower()
+
+    @model_validator(mode="after")
+    def _check_one_of(self) -> "V1TpuSpec":
+        if self.topology is None and self.count is None:
+            raise ValueError("tpu spec needs `topology` or `count`")
+        if self.topology is not None and self.count is not None:
+            raise ValueError(
+                "tpu spec takes `topology` OR `count`, not both "
+                f"(got topology={self.topology!r}, count={self.count})"
+            )
+        return self
+
+    @property
+    def dims(self) -> tuple[int, ...]:
+        if self.topology:
+            return tuple(int(d) for d in self.topology.split("x"))
+        return (int(self.count),)
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def num_hosts(self) -> int:
+        per_host = CHIPS_PER_HOST[self.type]
+        return max(1, self.num_chips // per_host)
+
+
+class V1ResourceRequirements(BaseSchema):
+    limits: Optional[dict[str, float | int | str]] = None
+    requests: Optional[dict[str, float | int | str]] = None
+
+
+class V1Resources(BaseSchema):
+    """Resources block. `tpu:` is the TPU-native extension; cpu/memory/gpu kept
+    for compatibility with stock Polyaxonfiles (gpu requests are rejected at
+    compile time by the TPU converter with a migration hint, not at parse
+    time, so `polyaxon check` can still lint legacy files)."""
+
+    cpu: Optional[float | int | str] = None
+    memory: Optional[str | int] = None
+    gpu: Optional[int] = None
+    tpu: Optional[V1TpuSpec] = None
+    limits: Optional[dict[str, float | int | str]] = None
+    requests: Optional[dict[str, float | int | str]] = None
+
+
+class V1Environment(BaseSchema):
+    resources: Optional[V1Resources] = None
+    labels: Optional[dict[str, str]] = None
+    annotations: Optional[dict[str, str]] = None
+    node_selector: Optional[dict[str, str]] = None
+    node_name: Optional[str] = None
+    tolerations: Optional[list[dict]] = None
+    affinity: Optional[dict] = None
+    service_account_name: Optional[str] = None
+    priority_class_name: Optional[str] = None
+    restart_policy: Optional[str] = None
+    image_pull_secrets: Optional[list[str]] = None
+    security_context: Optional[dict] = None
+    host_network: Optional[bool] = None
+    dns_policy: Optional[str] = None

@@ -1,0 +1,290 @@
+"""Hyperparameter search space + matrix (search algorithm) specs — "Polytune".
+
+Reference parity: upstream `V1Matrix{GridSearch,RandomSearch,Hyperband,Bayes,
+Hyperopt,Iterative,Mapping}` and `V1Hp*` param types (unverified, SURVEY.md §2
+"Polytune" row). Search execution lives in polyaxon_tpu/tuner/.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Literal, Optional, Union
+
+from pydantic import Field, field_validator, model_validator
+
+from .base import BaseSchema
+
+
+# ---------------------------------------------------------------- hp params
+class V1HpChoice(BaseSchema):
+    kind: Literal["choice"] = "choice"
+    value: list[Any]
+
+
+class V1HpPChoice(BaseSchema):
+    """Weighted choice: value is a list of [item, probability] pairs."""
+
+    kind: Literal["pchoice"] = "pchoice"
+    value: list[list[Any]]
+
+    @field_validator("value")
+    @classmethod
+    def _check(cls, v):
+        total = 0.0
+        for entry in v:
+            if not isinstance(entry, (list, tuple)) or len(entry) != 2:
+                raise ValueError(
+                    f"pchoice entries must be [item, probability] pairs, got {entry!r}"
+                )
+            try:
+                total += float(entry[1])
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"pchoice probability must be a number, got {entry[1]!r}"
+                ) from None
+        if abs(total - 1.0) > 1e-6:
+            raise ValueError(f"pchoice probabilities must sum to 1, got {total}")
+        return v
+
+
+class V1HpRange(BaseSchema):
+    """Integer range [start, stop) with step."""
+
+    kind: Literal["range"] = "range"
+    value: dict[str, int]
+
+    @model_validator(mode="after")
+    def _check(self):
+        missing = {"start", "stop"} - set(self.value)
+        if missing:
+            raise ValueError(f"range needs start/stop, missing {missing}")
+        self.value.setdefault("step", 1)
+        return self
+
+    def to_list(self) -> list[int]:
+        return list(range(self.value["start"], self.value["stop"], self.value["step"]))
+
+
+class V1HpLinSpace(BaseSchema):
+    kind: Literal["linspace"] = "linspace"
+    value: dict[str, float]
+
+    @model_validator(mode="after")
+    def _check(self):
+        missing = {"start", "stop", "num"} - set(self.value)
+        if missing:
+            raise ValueError(f"linspace needs start/stop/num, missing {missing}")
+        return self
+
+    def to_list(self) -> list[float]:
+        start, stop, num = self.value["start"], self.value["stop"], int(self.value["num"])
+        if num == 1:
+            return [start]
+        step = (stop - start) / (num - 1)
+        return [start + i * step for i in range(num)]
+
+
+class V1HpLogSpace(BaseSchema):
+    kind: Literal["logspace"] = "logspace"
+    value: dict[str, float]
+
+    @model_validator(mode="after")
+    def _check(self):
+        missing = {"start", "stop", "num"} - set(self.value)
+        if missing:
+            raise ValueError(f"logspace needs start/stop/num, missing {missing}")
+        return self
+
+    def to_list(self) -> list[float]:
+        base = self.value.get("base", 10.0)
+        start, stop, num = self.value["start"], self.value["stop"], int(self.value["num"])
+        if num == 1:
+            return [base**start]
+        step = (stop - start) / (num - 1)
+        return [base ** (start + i * step) for i in range(num)]
+
+
+class V1HpUniform(BaseSchema):
+    kind: Literal["uniform"] = "uniform"
+    value: dict[str, float]  # {low, high}
+
+    @model_validator(mode="after")
+    def _check(self):
+        if {"low", "high"} - set(self.value):
+            raise ValueError("uniform needs low/high")
+        return self
+
+
+class V1HpQUniform(BaseSchema):
+    kind: Literal["quniform"] = "quniform"
+    value: dict[str, float]  # {low, high, q}
+
+
+class V1HpLogUniform(BaseSchema):
+    kind: Literal["loguniform"] = "loguniform"
+    value: dict[str, float]  # {low, high} in log space
+
+
+class V1HpNormal(BaseSchema):
+    kind: Literal["normal"] = "normal"
+    value: dict[str, float]  # {loc, scale}
+
+
+class V1HpLogNormal(BaseSchema):
+    kind: Literal["lognormal"] = "lognormal"
+    value: dict[str, float]  # {loc, scale}
+
+
+V1HpParam = Union[
+    V1HpChoice,
+    V1HpPChoice,
+    V1HpRange,
+    V1HpLinSpace,
+    V1HpLogSpace,
+    V1HpUniform,
+    V1HpQUniform,
+    V1HpLogUniform,
+    V1HpNormal,
+    V1HpLogNormal,
+]
+
+DISCRETE_KINDS = {"choice", "pchoice", "range", "linspace", "logspace"}
+
+
+# ---------------------------------------------------------------- early stopping
+class V1MetricEarlyStopping(BaseSchema):
+    kind: Literal["metric_early_stopping"] = "metric_early_stopping"
+    metric: str
+    value: float
+    optimization: Literal["maximize", "minimize"] = "maximize"
+
+
+class V1MedianStoppingPolicy(BaseSchema):
+    kind: Literal["median"] = "median"
+    evaluation_interval: int = 1
+    min_interval: Optional[int] = None
+    min_samples: Optional[int] = None
+
+
+class V1TruncationStoppingPolicy(BaseSchema):
+    kind: Literal["truncation"] = "truncation"
+    percent: float = 50.0
+    evaluation_interval: int = 1
+    min_interval: Optional[int] = None
+    min_samples: Optional[int] = None
+
+
+V1EarlyStopping = Union[V1MetricEarlyStopping]
+V1StoppingPolicy = Union[V1MedianStoppingPolicy, V1TruncationStoppingPolicy]
+
+
+class V1OptimizationMetric(BaseSchema):
+    name: str
+    optimization: Literal["maximize", "minimize"] = "maximize"
+
+
+class V1OptimizationResource(BaseSchema):
+    """The resource Hyperband allocates (e.g. steps or epochs)."""
+
+    name: str
+    type: Literal["int", "float"] = "int"
+
+
+# ---------------------------------------------------------------- matrix kinds
+class V1MatrixBase(BaseSchema):
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[V1MetricEarlyStopping]] = None
+
+
+class V1GridSearch(V1MatrixBase):
+    kind: Literal["grid"] = "grid"
+    params: dict[str, V1HpParam]
+    num_runs: Optional[int] = None
+
+    @field_validator("params")
+    @classmethod
+    def _discrete(cls, v):
+        for name, p in v.items():
+            if p.kind not in DISCRETE_KINDS:
+                raise ValueError(
+                    f"grid search param {name!r} must be discrete, got {p.kind}"
+                )
+        return v
+
+
+class V1RandomSearch(V1MatrixBase):
+    kind: Literal["random"] = "random"
+    params: dict[str, V1HpParam]
+    num_runs: int
+    seed: Optional[int] = None
+
+
+class V1Hyperband(V1MatrixBase):
+    kind: Literal["hyperband"] = "hyperband"
+    params: dict[str, V1HpParam]
+    max_iterations: int  # R: max resource per config
+    eta: int = 3  # downsampling rate
+    resource: V1OptimizationResource
+    metric: V1OptimizationMetric
+    resume: Optional[bool] = None
+    seed: Optional[int] = None
+
+
+class V1Bayes(V1MatrixBase):
+    kind: Literal["bayes"] = "bayes"
+    params: dict[str, V1HpParam]
+    num_initial_runs: int
+    max_iterations: int
+    metric: V1OptimizationMetric
+    utility_function: Optional[dict] = None  # {acquisitionFunction: ucb|ei|pi, kappa, eps}
+    seed: Optional[int] = None
+
+
+class V1Hyperopt(V1MatrixBase):
+    kind: Literal["hyperopt"] = "hyperopt"
+    params: dict[str, V1HpParam]
+    num_runs: int
+    algorithm: Literal["tpe", "rand", "anneal"] = "tpe"
+    metric: Optional[V1OptimizationMetric] = None
+    seed: Optional[int] = None
+
+
+class V1Iterative(V1MatrixBase):
+    kind: Literal["iterative"] = "iterative"
+    params: dict[str, V1HpParam]
+    max_iterations: int
+    seed: Optional[int] = None
+    tuner: Optional[dict] = None
+
+
+class V1Mapping(V1MatrixBase):
+    kind: Literal["mapping"] = "mapping"
+    values: list[dict[str, Any]]
+
+
+V1Matrix = Union[
+    V1GridSearch,
+    V1RandomSearch,
+    V1Hyperband,
+    V1Bayes,
+    V1Hyperopt,
+    V1Iterative,
+    V1Mapping,
+]
+
+V1MatrixField = Annotated[V1Matrix, Field(discriminator="kind")]
+
+
+def parse_matrix(data: dict) -> V1Matrix:
+    kind = data.get("kind")
+    kinds = {
+        "grid": V1GridSearch,
+        "random": V1RandomSearch,
+        "hyperband": V1Hyperband,
+        "bayes": V1Bayes,
+        "hyperopt": V1Hyperopt,
+        "iterative": V1Iterative,
+        "mapping": V1Mapping,
+    }
+    if kind not in kinds:
+        raise ValueError(f"unknown matrix kind {kind!r}; one of {sorted(kinds)}")
+    return kinds[kind].model_validate(data)

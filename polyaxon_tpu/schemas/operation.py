@@ -1,0 +1,118 @@
+"""V1Operation: an invocation of a component with params/matrix/overrides.
+
+Reference parity: upstream `V1Operation` {component|hubRef|pathRef|urlRef,
+params, matrix, joins, schedule, events, hooks, termination, cache, patch
+strategy} (unverified, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from pydantic import field_validator, model_validator
+
+from .base import BaseSchema
+from .component import V1Cache, V1Component, V1Plugins
+from .environment import V1Environment
+from .io import V1Param
+from .matrix import V1MatrixField
+from .termination import V1Termination
+
+
+class V1Schedule(BaseSchema):
+    kind: str = "cron"  # cron | interval | datetime
+    cron: Optional[str] = None
+    start_at: Optional[str] = None
+    end_at: Optional[str] = None
+    frequency: Optional[int] = None  # seconds, for interval
+    max_runs: Optional[int] = None
+    depends_on_past: Optional[bool] = None
+
+
+class V1Join(BaseSchema):
+    query: str
+    sort: Optional[str] = None
+    limit: Optional[int] = None
+    params: Optional[dict[str, V1Param]] = None
+
+
+class V1Hook(BaseSchema):
+    hub_ref: Optional[str] = None
+    path_ref: Optional[str] = None
+    trigger: Optional[str] = None  # succeeded | failed | done
+    connection: Optional[str] = None
+    params: Optional[dict[str, V1Param]] = None
+
+
+class V1Operation(BaseSchema):
+    version: float | str = 1.1
+    kind: str = "operation"
+    name: Optional[str] = None
+    description: Optional[str] = None
+    tags: Optional[list[str]] = None
+    project: Optional[str] = None
+    queue: Optional[str] = None
+    presets: Optional[list[str]] = None
+    cache: Optional[V1Cache] = None
+    termination: Optional[V1Termination] = None
+    plugins: Optional[V1Plugins] = None
+    environment: Optional[V1Environment] = None  # patch onto component.run.environment
+    params: Optional[dict[str, V1Param]] = None
+    matrix: Optional[V1MatrixField] = None
+    joins: Optional[list[V1Join]] = None
+    schedule: Optional[V1Schedule] = None
+    events: Optional[list[dict]] = None
+    hooks: Optional[list[V1Hook]] = None
+    dependencies: Optional[list[str]] = None
+    trigger: Optional[str] = None
+    conditions: Optional[str] = None
+    skip_on_upstream_skip: Optional[bool] = None
+    patch_strategy: Optional[str] = None  # replace | isnull | post_merge | pre_merge
+    is_preset: Optional[bool] = None
+    is_approved: Optional[bool] = None
+    # component resolution (exactly one)
+    component: Optional[V1Component] = None
+    hub_ref: Optional[str] = None
+    path_ref: Optional[str] = None
+    url_ref: Optional[str] = None
+    dag_ref: Optional[str] = None
+    # run-section patch (merged onto the component's run at compile time)
+    run_patch: Optional[dict[str, Any]] = None
+
+    @field_validator("kind")
+    @classmethod
+    def _kind(cls, v):
+        if v != "operation":
+            raise ValueError(f"operation kind must be 'operation', got {v!r}")
+        return v
+
+    @field_validator("params", mode="before")
+    @classmethod
+    def _coerce_params(cls, v):
+        """Allow shorthand `params: {lr: 0.1}` → `{lr: {value: 0.1}}`."""
+        if not isinstance(v, dict):
+            return v
+        out = {}
+        for k, p in v.items():
+            if isinstance(p, dict) and ({"value", "ref", "contextOnly", "context_only", "connection", "toInit", "to_init"} & set(p)):
+                out[k] = p
+            else:
+                out[k] = {"value": p}
+        return out
+
+    @model_validator(mode="after")
+    def _check_refs(self):
+        refs = [
+            r
+            for r in (self.component, self.hub_ref, self.path_ref, self.url_ref, self.dag_ref)
+            if r is not None
+        ]
+        if len(refs) > 1:
+            raise ValueError(
+                "operation must set at most one of component/hubRef/pathRef/urlRef/dagRef"
+            )
+        return self
+
+    @property
+    def has_component(self) -> bool:
+        return self.component is not None

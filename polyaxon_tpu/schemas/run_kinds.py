@@ -1,0 +1,286 @@
+"""Run kinds: what a component executes.
+
+Reference parity (SURVEY.md §2 "Run kinds", unverified): upstream has V1Job,
+V1Service, V1TFJob/V1PyTorchJob/V1MPIJob/V1XGBoostJob/V1PaddleJob (Kubeflow
+replica specs), V1Dag, V1TunerJob. TPU-native addition per the north star:
+**V1JAXJob** — the kind this framework executes itself (no Kubeflow
+delegation): workers rendezvous via `jax.distributed`, shard over a
+`jax.sharding.Mesh` whose axes come from the `mesh:` block, and may run either
+a container command or a native `program:` (model/data/optimizer/train config
+interpreted by polyaxon_tpu/runtime/).
+
+Legacy distributed kinds (tfjob/pytorchjob/mpijob) parse for compatibility and
+are normalized to JAXJob by the compiler (compiler/resolver.py).
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Literal, Optional, Union
+
+from pydantic import Field, model_validator
+
+from .base import BaseSchema
+from .environment import V1Environment
+
+
+class V1Container(BaseSchema):
+    """Subset of a k8s container spec that both the k8s converter and the
+    local subprocess runner understand."""
+
+    name: Optional[str] = None
+    image: Optional[str] = None
+    command: Optional[list[str]] = None
+    args: Optional[list[str]] = None
+    env: Optional[dict[str, str] | list[dict[str, Any]]] = None
+    working_dir: Optional[str] = None
+    resources: Optional[dict] = None
+    volume_mounts: Optional[list[dict]] = None
+
+
+class V1Init(BaseSchema):
+    """Init-time artifact/git/file provisioning (runs before the main work)."""
+
+    artifacts: Optional[dict] = None
+    git: Optional[dict] = None
+    dockerfile: Optional[dict] = None
+    file: Optional[dict] = None
+    connection: Optional[str] = None
+    container: Optional[V1Container] = None
+    paths: Optional[list[str]] = None
+
+
+# ------------------------------------------------------------------ native program
+class V1ModelSpec(BaseSchema):
+    """A model from the registry (polyaxon_tpu/models/registry.py)."""
+
+    name: str
+    config: Optional[dict[str, Any]] = None
+
+
+# Scalar fields below accept `str` so `{{ params.x }}` templates survive parse
+# time; the compiler (compiler/resolver.py) interpolates and re-validates, after
+# which they are concrete numbers.
+class V1DataSpec(BaseSchema):
+    name: str = "synthetic"
+    batch_size: int | str = 32
+    config: Optional[dict[str, Any]] = None
+
+
+class V1OptimizerSpec(BaseSchema):
+    name: str = "adamw"
+    learning_rate: float | str = 1e-3
+    config: Optional[dict[str, Any]] = None
+    schedule: Optional[dict[str, Any]] = None
+
+
+class V1TrainSpec(BaseSchema):
+    steps: int | str = 100
+    eval_every: Optional[int | str] = None
+    eval_steps: Optional[int | str] = None
+    log_every: int | str = 10
+    checkpoint_every: Optional[int | str] = None
+    resume: Optional[bool] = None
+    seed: int | str = 0
+    precision: Literal["bfloat16", "float32", "mixed"] = "mixed"
+    remat: Optional[bool] = None
+    donate_state: bool = True
+    loss: Optional[str] = None
+
+
+class V1Program(BaseSchema):
+    """Native training program executed in-process by the JAXJob runtime
+    (runtime/trainer.py) — this replaces the reference's user-container +
+    Kubeflow delegation with an owned training loop."""
+
+    model: V1ModelSpec
+    data: Optional[V1DataSpec] = None
+    optimizer: Optional[V1OptimizerSpec] = None
+    train: Optional[V1TrainSpec] = None
+
+
+class V1MeshSpec(BaseSchema):
+    """Logical mesh axes → sizes. Recognized axes: data, fsdp, model (tensor),
+    pipeline, context (sequence), expert. Sizes must multiply to the chip
+    count of the tpu spec (validated at compile time, where both are known).
+    A size of -1 means 'fill with remaining devices' (at most one axis)."""
+
+    data: Optional[int] = None
+    fsdp: Optional[int] = None
+    model: Optional[int] = None
+    pipeline: Optional[int] = None
+    context: Optional[int] = None
+    expert: Optional[int] = None
+
+    def axis_sizes(self) -> dict[str, int]:
+        out = {}
+        for ax in ("data", "fsdp", "model", "pipeline", "context", "expert"):
+            v = getattr(self, ax)
+            if v is not None:
+                out[ax] = v
+        return out
+
+    @model_validator(mode="after")
+    def _check(self):
+        sizes = self.axis_sizes()
+        n_fill = sum(1 for v in sizes.values() if v == -1)
+        if n_fill > 1:
+            raise ValueError("at most one mesh axis may be -1 (auto-fill)")
+        for ax, v in sizes.items():
+            if v == 0 or v < -1:
+                raise ValueError(f"mesh axis {ax!r} has invalid size {v}")
+        return self
+
+
+# ------------------------------------------------------------------ run kinds
+class V1Job(BaseSchema):
+    kind: Literal["job"] = "job"
+    container: Optional[V1Container] = None
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+    volumes: Optional[list[dict]] = None
+
+
+class V1Service(BaseSchema):
+    kind: Literal["service"] = "service"
+    container: Optional[V1Container] = None
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+    volumes: Optional[list[dict]] = None
+    ports: Optional[list[int]] = None
+    rewrite_path: Optional[bool] = None
+    is_external: Optional[bool] = None
+    replicas: Optional[int] = None
+
+
+class V1JAXJob(BaseSchema):
+    """TPU-native distributed training job (the framework's own runtime)."""
+
+    kind: Literal["jaxjob"] = "jaxjob"
+    replicas: int = 1  # host processes; each host drives its local chips
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+    container: Optional[V1Container] = None
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+    volumes: Optional[list[dict]] = None
+    coordinator_port: int = 8476
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.program is None and self.container is None:
+            raise ValueError("jaxjob needs `program` (native) or `container`")
+        return self
+
+
+class V1KFReplica(BaseSchema):
+    """Replica spec of legacy Kubeflow-style kinds (chief/worker/ps/master)."""
+
+    replicas: int = 1
+    container: Optional[V1Container] = None
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+
+
+class V1TFJob(BaseSchema):
+    kind: Literal["tfjob"] = "tfjob"
+    chief: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    ps: Optional[V1KFReplica] = None
+    evaluator: Optional[V1KFReplica] = None
+    clean_pod_policy: Optional[str] = None
+    # native-extension passthroughs so legacy kinds can still pick a mesh/program
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
+class V1PyTorchJob(BaseSchema):
+    kind: Literal["pytorchjob"] = "pytorchjob"
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    clean_pod_policy: Optional[str] = None
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
+class V1MPIJob(BaseSchema):
+    kind: Literal["mpijob"] = "mpijob"
+    launcher: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    slots_per_worker: Optional[int] = None
+    clean_pod_policy: Optional[str] = None
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
+class V1TunerJob(BaseSchema):
+    """Auxiliary tuner job driving a matrix sweep (Polytune)."""
+
+    kind: Literal["tuner"] = "tuner"
+    container: Optional[V1Container] = None
+    environment: Optional[V1Environment] = None
+
+
+class V1Dag(BaseSchema):
+    kind: Literal["dag"] = "dag"
+    operations: list["V1OperationRef"] = Field(default_factory=list)
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[dict]] = None
+    environment: Optional[V1Environment] = None
+
+
+class V1OperationRef(BaseSchema):
+    """An operation inside a DAG: inline component or path ref + deps."""
+
+    name: str
+    dag_ref: Optional[str] = None
+    path_ref: Optional[str] = None
+    hub_ref: Optional[str] = None
+    component: Optional[dict] = None  # inline component (validated lazily)
+    params: Optional[dict[str, Any]] = None
+    depends_on: Optional[list[str]] = None
+    trigger: Optional[str] = None  # all_succeeded | all_done | one_succeeded ...
+    conditions: Optional[str] = None
+
+
+V1Dag.model_rebuild()
+
+V1RunKind = Union[
+    V1Job,
+    V1Service,
+    V1JAXJob,
+    V1TFJob,
+    V1PyTorchJob,
+    V1MPIJob,
+    V1TunerJob,
+    V1Dag,
+]
+
+# Discriminated-union form for embedding in parent schemas: pydantic dispatches
+# on `kind` and produces clean per-kind errors.
+V1RunKindField = Annotated[V1RunKind, Field(discriminator="kind")]
+
+RUN_KINDS: dict[str, type] = {
+    "job": V1Job,
+    "service": V1Service,
+    "jaxjob": V1JAXJob,
+    "tfjob": V1TFJob,
+    "pytorchjob": V1PyTorchJob,
+    "mpijob": V1MPIJob,
+    "tuner": V1TunerJob,
+    "dag": V1Dag,
+}
+
+
+def parse_run(data: dict) -> V1RunKind:
+    kind = data.get("kind")
+    if kind not in RUN_KINDS:
+        raise ValueError(f"unknown run kind {kind!r}; one of {sorted(RUN_KINDS)}")
+    return RUN_KINDS[kind].model_validate(data)

@@ -1,0 +1,18 @@
+"""Termination policy: retries, TTL, timeout.
+
+Reference parity: upstream `V1Termination` {maxRetries, ttl, timeout}
+(unverified, SURVEY.md §5 failure-detection row). The local scheduler and the
+C++ supervisor both honor max_retries; ttl drives cleanup.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import BaseSchema
+
+
+class V1Termination(BaseSchema):
+    max_retries: Optional[int] = None
+    ttl: Optional[int] = None  # seconds after finish before cleanup
+    timeout: Optional[int] = None  # max runtime seconds

@@ -1,0 +1,23 @@
+"""Test harness: fake an 8-device TPU slice on CPU so sharding/collective
+tests run without hardware (SURVEY.md §4: the reference tests multi-node by
+golden-rendering specs; we additionally execute on a virtual mesh)."""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture()
+def tmp_home(tmp_path, monkeypatch):
+    """Isolated POLYAXON_HOME so tests never touch the real run store."""
+    home = tmp_path / "polyaxon_home"
+    monkeypatch.setenv("POLYAXON_HOME", str(home))
+    return home

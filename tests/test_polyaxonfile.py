@@ -1,0 +1,120 @@
+"""Polyaxonfile reader tests: file parsing, CLI params, check summaries."""
+
+import pytest
+
+from polyaxon_tpu.polyaxonfile import (
+    PolyaxonfileError,
+    check_polyaxonfile,
+    read_polyaxonfile,
+    read_specs,
+)
+from polyaxon_tpu.polyaxonfile.reader import parse_cli_param
+
+GOOD = """
+version: 1.1
+kind: operation
+name: mnist
+params:
+  lr: 0.01
+component:
+  kind: component
+  inputs:
+  - {name: lr, type: float, value: 0.1}
+  run:
+    kind: jaxjob
+    program:
+      model: {name: mlp}
+"""
+
+BARE_COMPONENT = """
+kind: component
+name: hello
+run:
+  kind: job
+  container: {command: ["echo", "hi"]}
+"""
+
+
+def _write(tmp_path, text, name="poly.yaml"):
+    p = tmp_path / name
+    p.write_text(text)
+    return p
+
+
+def test_read_operation(tmp_path):
+    op = read_polyaxonfile(_write(tmp_path, GOOD))
+    assert op.name == "mnist"
+    assert op.params["lr"].value == 0.01
+
+
+def test_bare_component_wrapped(tmp_path):
+    op = read_polyaxonfile(_write(tmp_path, BARE_COMPONENT))
+    assert op.component.name == "hello"
+    assert op.name == "hello"
+
+
+def test_cli_params_override(tmp_path):
+    op = read_polyaxonfile(_write(tmp_path, GOOD), params={"lr": 0.5, "extra": "x"})
+    assert op.params["lr"].value == 0.5
+    assert op.params["extra"].value == "x"
+
+
+def test_parse_cli_param_types():
+    assert parse_cli_param("lr=0.1") == ("lr", 0.1)
+    assert parse_cli_param("n=3") == ("n", 3)
+    assert parse_cli_param("flag=true") == ("flag", True)
+    assert parse_cli_param("xs=[1, 2]") == ("xs", [1, 2])
+    assert parse_cli_param("s=hello") == ("s", "hello")
+    with pytest.raises(PolyaxonfileError):
+        parse_cli_param("noequals")
+
+
+def test_missing_file():
+    with pytest.raises(PolyaxonfileError, match="not found"):
+        read_polyaxonfile("/nonexistent/x.yaml")
+
+
+def test_empty_file(tmp_path):
+    with pytest.raises(PolyaxonfileError, match="empty"):
+        read_polyaxonfile(_write(tmp_path, "\n"))
+
+
+def test_bad_kind(tmp_path):
+    with pytest.raises(PolyaxonfileError, match="kind"):
+        read_polyaxonfile(_write(tmp_path, "kind: pipeline\nname: x\n"))
+
+
+def test_invalid_spec_has_location(tmp_path):
+    bad = GOOD.replace("kind: jaxjob", "kind: jaxjob\n    replicas: -2")
+    with pytest.raises(PolyaxonfileError):
+        read_polyaxonfile(_write(tmp_path, bad.replace("model: {name: mlp}", "")))
+
+
+def test_multidoc(tmp_path):
+    ops = read_specs(_write(tmp_path, GOOD + "\n---\n" + BARE_COMPONENT))
+    assert len(ops) == 2
+    with pytest.raises(PolyaxonfileError, match="2 specs"):
+        read_polyaxonfile(_write(tmp_path, GOOD + "\n---\n" + BARE_COMPONENT))
+
+
+def test_check_summary(tmp_path):
+    out = check_polyaxonfile(_write(tmp_path, GOOD))
+    assert out == [
+        {
+            "name": "mnist",
+            "kind": "operation",
+            "run_kind": "jaxjob",
+            "params": ["lr"],
+            "matrix": None,
+        }
+    ]
+
+
+def test_examples_all_check():
+    """Every shipped example polyaxonfile must validate."""
+    from pathlib import Path
+
+    examples = sorted(Path(__file__).parent.parent.glob("examples/*.yaml"))
+    assert examples, "no example polyaxonfiles found"
+    for ex in examples:
+        assert check_polyaxonfile(ex), ex

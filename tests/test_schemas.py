@@ -1,0 +1,209 @@
+"""Spec-layer unit tests: parse/validate good & bad polyaxonfiles and
+round-trip serialization (mirrors the reference's spec test strategy,
+SURVEY.md §4 row 1)."""
+
+import pytest
+from pydantic import ValidationError
+
+from polyaxon_tpu.schemas import (
+    V1Component,
+    V1GridSearch,
+    V1Hyperband,
+    V1JAXJob,
+    V1MeshSpec,
+    V1Operation,
+    V1Statuses,
+    V1TpuSpec,
+    can_transition,
+    parse_matrix,
+    parse_run,
+)
+
+
+def test_component_requires_run():
+    with pytest.raises(ValidationError):
+        V1Component.model_validate({"kind": "component", "name": "x"})
+
+
+def test_component_jaxjob_parses():
+    c = V1Component.model_validate(
+        {
+            "kind": "component",
+            "name": "train",
+            "inputs": [{"name": "lr", "type": "float", "value": 0.1}],
+            "run": {
+                "kind": "jaxjob",
+                "program": {"model": {"name": "mlp"}},
+                "mesh": {"data": 4, "model": 2},
+            },
+        }
+    )
+    assert isinstance(c.run, V1JAXJob)
+    assert c.run.mesh.axis_sizes() == {"data": 4, "model": 2}
+
+
+def test_jaxjob_needs_program_or_container():
+    with pytest.raises(ValidationError):
+        parse_run({"kind": "jaxjob"})
+
+
+def test_unknown_run_kind_rejected():
+    with pytest.raises(ValueError, match="unknown run kind"):
+        parse_run({"kind": "sparkjob"})
+
+
+def test_io_type_validation():
+    c = V1Component.model_validate(
+        {
+            "kind": "component",
+            "inputs": [{"name": "lr", "type": "float"}],
+            "run": {"kind": "job", "container": {"command": ["true"]}},
+        }
+    )
+    io = c.get_input("lr")
+    assert io.validate_value("0.5") == 0.5
+    with pytest.raises(ValueError):
+        io.validate_value("abc")
+    with pytest.raises(ValueError):
+        io.validate_value(None)  # required, no default
+
+
+def test_tpu_spec_topology():
+    t = V1TpuSpec.model_validate({"type": "v5e", "topology": "4x8"})
+    assert t.num_chips == 32
+    assert t.num_hosts == 8
+    assert t.dims == (4, 8)
+    with pytest.raises(ValidationError):
+        V1TpuSpec.model_validate({"type": "v5e", "topology": "4xbad"})
+    with pytest.raises(ValidationError):
+        V1TpuSpec.model_validate({"type": "v99", "count": 8})
+    with pytest.raises(ValidationError):
+        V1TpuSpec.model_validate({"type": "v5e"})  # needs topology or count
+
+
+def test_mesh_spec_validation():
+    m = V1MeshSpec.model_validate({"data": -1, "model": 4})
+    assert m.axis_sizes() == {"data": -1, "model": 4}
+    with pytest.raises(ValidationError):
+        V1MeshSpec.model_validate({"data": -1, "model": -1})
+    with pytest.raises(ValidationError):
+        V1MeshSpec.model_validate({"data": 0})
+
+
+def test_operation_param_shorthand():
+    op = V1Operation.model_validate(
+        {"kind": "operation", "hubRef": "x", "params": {"lr": 0.1, "full": {"value": 2}}}
+    )
+    assert op.params["lr"].value == 0.1
+    assert op.params["full"].value == 2
+
+
+def test_operation_single_ref():
+    with pytest.raises(ValidationError):
+        V1Operation.model_validate(
+            {"kind": "operation", "hubRef": "a", "pathRef": "b"}
+        )
+
+
+def test_matrix_kinds_parse():
+    g = parse_matrix(
+        {"kind": "grid", "params": {"lr": {"kind": "choice", "value": [0.1, 0.2]}}}
+    )
+    assert isinstance(g, V1GridSearch)
+    h = parse_matrix(
+        {
+            "kind": "hyperband",
+            "params": {"lr": {"kind": "uniform", "value": {"low": 0.0, "high": 1.0}}},
+            "maxIterations": 81,
+            "eta": 3,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+        }
+    )
+    assert isinstance(h, V1Hyperband)
+    with pytest.raises(ValueError, match="unknown matrix kind"):
+        parse_matrix({"kind": "simulated_annealing"})
+
+
+def test_grid_rejects_continuous_params():
+    with pytest.raises(ValidationError, match="must be discrete"):
+        parse_matrix(
+            {
+                "kind": "grid",
+                "params": {"lr": {"kind": "uniform", "value": {"low": 0, "high": 1}}},
+            }
+        )
+
+
+def test_hp_space_helpers():
+    from polyaxon_tpu.schemas import V1HpLinSpace, V1HpLogSpace
+    from polyaxon_tpu.schemas.matrix import V1HpRange
+
+    assert V1HpRange.model_validate(
+        {"kind": "range", "value": {"start": 0, "stop": 6, "step": 2}}
+    ).to_list() == [0, 2, 4]
+    ls = V1HpLinSpace.model_validate(
+        {"kind": "linspace", "value": {"start": 0.0, "stop": 1.0, "num": 3}}
+    ).to_list()
+    assert ls == [0.0, 0.5, 1.0]
+    lg = V1HpLogSpace.model_validate(
+        {"kind": "logspace", "value": {"start": 0.0, "stop": 2.0, "num": 3}}
+    ).to_list()
+    assert lg == pytest.approx([1.0, 10.0, 100.0])
+
+
+def test_pchoice_probability_sum():
+    with pytest.raises(ValidationError):
+        parse_matrix(
+            {
+                "kind": "random",
+                "numRuns": 3,
+                "params": {"x": {"kind": "pchoice", "value": [["a", 0.5], ["b", 0.2]]}},
+            }
+        )
+
+
+def test_lifecycle_transitions():
+    assert can_transition(V1Statuses.CREATED, V1Statuses.COMPILED)
+    assert can_transition(V1Statuses.COMPILED, V1Statuses.QUEUED)
+    assert can_transition(V1Statuses.RUNNING, V1Statuses.SUCCEEDED)
+    assert not can_transition(V1Statuses.SUCCEEDED, V1Statuses.RUNNING)
+    assert not can_transition(V1Statuses.CREATED, V1Statuses.RUNNING)
+    assert can_transition(V1Statuses.FAILED, V1Statuses.RETRYING)
+
+
+def test_roundtrip_serialization():
+    doc = {
+        "kind": "operation",
+        "name": "sweep",
+        "matrix": {
+            "kind": "random",
+            "numRuns": 4,
+            "seed": 7,
+            "params": {"lr": {"kind": "loguniform", "value": {"low": -6.0, "high": -1.0}}},
+        },
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "jaxjob",
+                "program": {"model": {"name": "vit"}},
+                "environment": {"resources": {"tpu": {"type": "v5e", "topology": "2x4"}}},
+            },
+        },
+    }
+    op = V1Operation.model_validate(doc)
+    d1 = op.to_dict()
+    d2 = V1Operation.model_validate(d1).to_dict()
+    assert d1 == d2
+    assert d1["matrix"]["numRuns"] == 4  # camelCase surface preserved
+
+
+def test_legacy_kinds_parse():
+    for kind, replica in (("tfjob", "worker"), ("pytorchjob", "master"), ("mpijob", "launcher")):
+        r = parse_run(
+            {
+                "kind": kind,
+                replica: {"replicas": 2, "container": {"image": "x", "command": ["t"]}},
+            }
+        )
+        assert r.kind == kind
