@@ -1,0 +1,3 @@
+from .contexts import build_context, build_globals, resolve_params
+from .interpolation import CompilationError, has_template, interpolate, interpolate_str
+from .resolver import CompiledOperation, apply_suggestion, compile_operation

@@ -82,7 +82,7 @@ class V1TpuSpec(BaseSchema):
     @property
     def num_hosts(self) -> int:
         per_host = CHIPS_PER_HOST[self.type]
-        return max(1, self.num_chips // per_host)
+        return max(1, -(-self.num_chips // per_host))  # ceil: partial hosts count
 
 
 class V1ResourceRequirements(BaseSchema):

@@ -58,6 +58,14 @@ class V1HpRange(BaseSchema):
         if missing:
             raise ValueError(f"range needs start/stop, missing {missing}")
         self.value.setdefault("step", 1)
+        step = self.value["step"]
+        if step == 0:
+            raise ValueError("range step must not be zero")
+        if (self.value["stop"] - self.value["start"]) * step < 0:
+            raise ValueError(
+                f"range start={self.value['start']} stop={self.value['stop']} "
+                f"step={step} is empty (step sign mismatch)"
+            )
         return self
 
     def to_list(self) -> list[int]:

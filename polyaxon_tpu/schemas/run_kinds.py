@@ -153,14 +153,14 @@ class V1Service(BaseSchema):
     ports: Optional[list[int]] = None
     rewrite_path: Optional[bool] = None
     is_external: Optional[bool] = None
-    replicas: Optional[int] = None
+    replicas: Optional[int] = Field(default=None, ge=1)
 
 
 class V1JAXJob(BaseSchema):
     """TPU-native distributed training job (the framework's own runtime)."""
 
     kind: Literal["jaxjob"] = "jaxjob"
-    replicas: int = 1  # host processes; each host drives its local chips
+    replicas: int = Field(default=1, ge=1)  # host processes; each drives its local chips
     mesh: Optional[V1MeshSpec] = None
     program: Optional[V1Program] = None
     container: Optional[V1Container] = None
@@ -181,7 +181,7 @@ class V1JAXJob(BaseSchema):
 class V1KFReplica(BaseSchema):
     """Replica spec of legacy Kubeflow-style kinds (chief/worker/ps/master)."""
 
-    replicas: int = 1
+    replicas: int = Field(default=1, ge=1)
     container: Optional[V1Container] = None
     init: Optional[list[V1Init]] = None
     sidecars: Optional[list[V1Container]] = None

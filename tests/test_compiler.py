@@ -1,0 +1,229 @@
+"""Compiler tests: interpolation, param resolution, patches, legacy-kind
+normalization, mesh validation (mirrors reference compiler-test strategy,
+SURVEY.md §4 row 3)."""
+
+import pytest
+
+from polyaxon_tpu.compiler import (
+    CompilationError,
+    apply_suggestion,
+    compile_operation,
+    interpolate,
+    interpolate_str,
+)
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.schemas import V1Operation
+
+CTX = {"params": {"lr": 0.01, "name": "x"}, "globals": {"uuid": "abc123"}}
+
+
+def _op(doc):
+    return V1Operation.model_validate(doc)
+
+
+def jaxjob_op(**over):
+    doc = {
+        "kind": "operation",
+        "name": "t",
+        "component": {
+            "kind": "component",
+            "inputs": [
+                {"name": "lr", "type": "float", "value": 0.1},
+                {"name": "steps", "type": "int", "value": 10},
+            ],
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {"name": "mlp"},
+                    "optimizer": {"learningRate": "{{ params.lr }}"},
+                    "train": {"steps": "{{ params.steps }}"},
+                },
+            },
+        },
+    }
+    doc.update(over)
+    return _op(doc)
+
+
+class TestInterpolation:
+    def test_typed_whole_string(self):
+        assert interpolate_str("{{ params.lr }}", CTX) == 0.01
+        assert isinstance(interpolate_str("{{ params.lr }}", CTX), float)
+
+    def test_embedded_substitution(self):
+        assert interpolate_str("run-{{ globals.uuid }}-{{ params.name }}", CTX) == "run-abc123-x"
+
+    def test_nested_structures(self):
+        out = interpolate({"a": ["{{ params.lr }}", {"b": "{{ globals.uuid }}"}]}, CTX)
+        assert out == {"a": [0.01, {"b": "abc123"}]}
+
+    def test_unknown_reference(self):
+        with pytest.raises(CompilationError, match="unknown reference"):
+            interpolate_str("{{ params.missing }}", CTX)
+        with pytest.raises(CompilationError, match="available"):
+            interpolate_str("{{ params.missing }}", CTX)
+
+
+class TestCompile:
+    def test_params_resolve_with_defaults(self):
+        c = compile_operation(jaxjob_op())
+        assert c.params == {"lr": 0.1, "steps": 10}
+        assert c.run.program.optimizer.learning_rate == 0.1
+        assert c.run.program.train.steps == 10
+
+    def test_param_override_and_coercion(self):
+        op = jaxjob_op(params={"lr": {"value": "0.5"}})
+        c = compile_operation(op)
+        assert c.params["lr"] == 0.5
+
+    def test_bad_param_type(self):
+        op = jaxjob_op(params={"lr": {"value": "abc"}})
+        with pytest.raises((CompilationError, ValueError)):
+            compile_operation(op)
+
+    def test_missing_required_param(self):
+        op = _op(
+            {
+                "kind": "operation",
+                "component": {
+                    "kind": "component",
+                    "inputs": [{"name": "req", "type": "int"}],
+                    "run": {"kind": "job", "container": {"command": ["x"]}},
+                },
+            }
+        )
+        with pytest.raises(CompilationError, match="required"):
+            compile_operation(op)
+
+    def test_globals_paths(self):
+        c = compile_operation(jaxjob_op(), run_uuid="u1", artifacts_root="/tmp/a")
+        g = c.contexts["globals"]
+        assert g["run_artifacts_path"] == "/tmp/a/u1"
+        assert g["run_outputs_path"] == "/tmp/a/u1/outputs"
+
+    def test_run_patch(self):
+        op = jaxjob_op(run_patch={"program": {"train": {"logEvery": 99}}})
+        c = compile_operation(op)
+        assert c.run.program.train.log_every == 99
+        assert c.run.program.model.name == "mlp"  # untouched
+
+    def test_environment_patch(self):
+        op = jaxjob_op(
+            environment={"resources": {"tpu": {"type": "v5e", "topology": "2x2"}}}
+        )
+        c = compile_operation(op)
+        assert c.run.environment.resources.tpu.num_chips == 4
+
+    def test_termination_merge(self):
+        op = jaxjob_op(termination={"maxRetries": 3})
+        c = compile_operation(op)
+        assert c.component.termination.max_retries == 3
+
+
+class TestMeshValidation:
+    def _with_mesh(self, mesh, topology="2x4"):
+        op = jaxjob_op()
+        return _op(
+            {
+                **op.to_dict(),
+                "runPatch": {
+                    "mesh": mesh,
+                    "environment": {"resources": {"tpu": {"type": "v5e", "topology": topology}}},
+                },
+            }
+        )
+
+    def test_autofill(self):
+        c = compile_operation(self._with_mesh({"data": -1, "model": 2}))
+        assert c.run.mesh.axis_sizes() == {"data": 4, "model": 2}
+
+    def test_exact(self):
+        c = compile_operation(self._with_mesh({"data": 8}))
+        assert c.run.mesh.axis_sizes() == {"data": 8}
+
+    def test_mismatch(self):
+        with pytest.raises(CompilationError, match="chips"):
+            compile_operation(self._with_mesh({"data": 3}))
+
+    def test_indivisible_autofill(self):
+        with pytest.raises(CompilationError, match="divide"):
+            compile_operation(self._with_mesh({"data": -1, "model": 3}))
+
+    def test_gpu_rejected(self):
+        op = jaxjob_op(environment={"resources": {"gpu": 4}})
+        with pytest.raises(CompilationError, match="tpu"):
+            compile_operation(op)
+
+
+class TestLegacyKinds:
+    def _legacy(self, kind, groups):
+        return _op(
+            {
+                "kind": "operation",
+                "component": {
+                    "kind": "component",
+                    "run": {
+                        "kind": kind,
+                        **groups,
+                        "program": {"model": {"name": "mlp"}},
+                    },
+                },
+            }
+        )
+
+    def test_tfjob_normalizes(self):
+        op = self._legacy(
+            "tfjob",
+            {
+                "chief": {"replicas": 1, "container": {"command": ["t"]}},
+                "worker": {"replicas": 3},
+            },
+        )
+        c = compile_operation(op)
+        assert c.run.kind == "jaxjob"
+        assert c.run.replicas == 4
+        assert c.run.mesh.axis_sizes() == {"data": -1}
+
+    def test_pytorchjob_normalizes(self):
+        op = self._legacy(
+            "pytorchjob",
+            {"master": {"replicas": 1}, "worker": {"replicas": 7}},
+        )
+        c = compile_operation(op)
+        assert c.run.kind == "jaxjob"
+        assert c.run.replicas == 8
+
+    def test_tfjob_ps_rejected(self):
+        op = self._legacy(
+            "tfjob", {"worker": {"replicas": 2}, "ps": {"replicas": 1}}
+        )
+        with pytest.raises(CompilationError, match="parameter servers"):
+            compile_operation(op)
+
+
+EXAMPLES = __import__("pathlib").Path(__file__).parent.parent / "examples"
+
+
+class TestSuggestions:
+    def test_apply_suggestion(self):
+        op = read_polyaxonfile(EXAMPLES / "vit_hyperband.yaml")
+        child = apply_suggestion(op, {"lr": 0.003, "batch_size": 256})
+        assert child.matrix is None
+        assert child.params["lr"].value == 0.003
+        c = compile_operation(child)
+        assert c.run.program.optimizer.learning_rate == 0.003
+        assert c.run.program.data.batch_size == 256
+
+
+def test_all_examples_compile():
+    examples = sorted(EXAMPLES.glob("*.yaml"))
+    assert examples, "no example polyaxonfiles found"
+    for ex in examples:
+        op = read_polyaxonfile(ex)
+        if op.matrix is not None:
+            op = apply_suggestion(op, {})
+        c = compile_operation(op)
+        assert c.run.kind == "jaxjob"
+        from polyaxon_tpu.compiler import has_template
+
+        assert not has_template(c.component.to_dict()), f"{ex} left templates"

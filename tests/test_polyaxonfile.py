@@ -84,10 +84,10 @@ def test_bad_kind(tmp_path):
         read_polyaxonfile(_write(tmp_path, "kind: pipeline\nname: x\n"))
 
 
-def test_invalid_spec_has_location(tmp_path):
+def test_negative_replicas_rejected(tmp_path):
     bad = GOOD.replace("kind: jaxjob", "kind: jaxjob\n    replicas: -2")
-    with pytest.raises(PolyaxonfileError):
-        read_polyaxonfile(_write(tmp_path, bad.replace("model: {name: mlp}", "")))
+    with pytest.raises(PolyaxonfileError, match="replicas"):
+        read_polyaxonfile(_write(tmp_path, bad))
 
 
 def test_multidoc(tmp_path):
