@@ -1,0 +1,6 @@
+"""polyaxon_tpu: a TPU-native experiment-orchestration + training framework
+with the capabilities of the reference Polyaxon (see SURVEY.md), rebuilt
+jax/XLA-first: Polyaxonfile surface on top, JAXJob runtime (mesh + pjit +
+Pallas) underneath instead of Kubeflow/NCCL delegation."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,181 @@
+"""`polyaxon` CLI — the user surface (SURVEY.md §2 "CLI", §3 stacks (a)/(e)).
+
+Commands (parity with the reference's core verbs, local-first execution):
+  polyaxon run -f file.yaml [-P name=value] [--eager/--local]
+  polyaxon check -f file.yaml
+  polyaxon ops ls / get / logs / statuses / stop [-uid UID]
+  polyaxon tuner ... (sweep driving; Polytune)
+  polyaxon version
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import click
+
+from .. import __version__
+from ..compiler.resolver import CompilationError, compile_operation
+from ..polyaxonfile.reader import PolyaxonfileError, read_polyaxonfile
+from ..schemas.lifecycle import V1Statuses
+from ..store.local import RunStore
+
+
+@click.group()
+def cli():
+    """Polyaxon-TPU: experiment orchestration, natively on TPU."""
+
+
+@cli.command()
+def version():
+    click.echo(f"polyaxon-tpu {__version__}")
+
+
+def _params_to_dict(params):
+    out = {}
+    for p in params:
+        if "=" not in p:
+            raise click.BadParameter(f"-P expects name=value, got {p!r}")
+        k, v = p.split("=", 1)
+        try:
+            v = json.loads(v)
+        except (ValueError, json.JSONDecodeError):
+            pass  # keep as string
+        out[k] = v
+    return out
+
+
+@cli.command()
+@click.option("-f", "--file", "fpath", required=True, type=click.Path(exists=True))
+@click.option("-P", "--param", "params", multiple=True, help="override: name=value")
+@click.option("--name", default=None, help="override run name")
+@click.option("--project", default="default")
+@click.option("--watch/--no-watch", default=False, help="stream logs after submit")
+def run(fpath, params, name, project, watch):
+    """Submit a polyaxonfile for execution (local executor)."""
+    try:
+        op = read_polyaxonfile(fpath, params=_params_to_dict(params))
+    except PolyaxonfileError as e:
+        raise click.ClickException(str(e))
+    if name:
+        op = op.model_copy(update={"name": name})
+    store = RunStore()
+    if op.matrix is not None:
+        from ..tuner.driver import run_sweep
+
+        results = run_sweep(op, store=store, project=project, base_dir=None)
+        click.echo(json.dumps(results, indent=1, default=str))
+        return
+    try:
+        compiled = compile_operation(
+            op,
+            project=project,
+            artifacts_root=str(store.runs_dir),
+            base_dir=None,
+        )
+    except CompilationError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"run {compiled.run_uuid[:8]} ({compiled.name}) created")
+    from ..runtime.executor import Executor
+
+    status = Executor(store).execute(compiled)
+    click.echo(f"run {compiled.run_uuid[:8]} finished: {status}")
+    if status == V1Statuses.FAILED:
+        click.echo(store.read_logs(compiled.run_uuid), err=True)
+        sys.exit(1)
+    if watch:
+        click.echo(store.read_logs(compiled.run_uuid))
+
+
+@cli.command()
+@click.option("-f", "--file", "fpath", required=True, type=click.Path(exists=True))
+def check(fpath):
+    """Validate + dry-compile a polyaxonfile, print the resolved spec."""
+    try:
+        op = read_polyaxonfile(fpath)
+        compiled = compile_operation(op, base_dir=None)
+    except (PolyaxonfileError, CompilationError) as e:
+        raise click.ClickException(str(e))
+    click.echo(json.dumps(compiled.to_dict(), indent=1, default=str))
+
+
+@cli.group()
+def ops():
+    """Inspect and manage runs."""
+
+
+@ops.command("ls")
+@click.option("--project", default=None)
+def ops_ls(project):
+    store = RunStore()
+    rows = store.list_runs(project)
+    if not rows:
+        click.echo("no runs")
+        return
+    for r in rows:
+        click.echo(
+            f"{r['uuid'][:8]}  {r.get('status', '?'):<12} {r.get('project', ''):<12} {r.get('name', '')}"
+        )
+
+
+@ops.command("get")
+@click.option("-uid", "--uid", required=True)
+def ops_get(uid):
+    store = RunStore()
+    uid = store.resolve(uid)
+    out = {
+        "status": store.get_status(uid),
+        "spec": store.read_spec(uid),
+        "metrics_tail": store.read_metrics(uid)[-5:],
+    }
+    click.echo(json.dumps(out, indent=1, default=str))
+
+
+@ops.command("logs")
+@click.option("-uid", "--uid", required=True)
+@click.option("--follow/--no-follow", default=False)
+def ops_logs(uid, follow):
+    store = RunStore()
+    uid = store.resolve(uid)
+    if follow:
+        for chunk in store.watch_logs(uid):
+            click.echo(chunk, nl=False)
+    else:
+        click.echo(store.read_logs(uid), nl=False)
+
+
+@ops.command("statuses")
+@click.option("-uid", "--uid", required=True)
+def ops_statuses(uid):
+    store = RunStore()
+    uid = store.resolve(uid)
+    for c in store.get_status(uid).get("conditions", []):
+        click.echo(f"{c.get('ts', 0):.3f}  {c['type']:<12} {c.get('reason', '')}")
+
+
+@ops.command("metrics")
+@click.option("-uid", "--uid", required=True)
+def ops_metrics(uid):
+    store = RunStore()
+    uid = store.resolve(uid)
+    for m in store.read_metrics(uid):
+        click.echo(json.dumps(m))
+
+
+@ops.command("stop")
+@click.option("-uid", "--uid", required=True)
+def ops_stop(uid):
+    store = RunStore()
+    uid = store.resolve(uid)
+    store.set_status(uid, V1Statuses.STOPPING)
+    store.set_status(uid, V1Statuses.STOPPED)
+    click.echo(f"{uid[:8]} stopped")
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Data pipelines: `program.data.name` → an infinite iterator of batches.
+
+The environment has zero egress, so real dataset downloads are impossible;
+every pipeline here is procedurally generated but *learnable* (fixed class
+prototypes + noise) so training curves actually descend — that is what the
+reference's examples demonstrate and what tests assert.
+
+Pipelines yield host-local numpy batches with STATIC shapes; the trainer
+lays them onto the mesh (runtime/trainer.py). Generation happens on CPU in
+plain numpy, off the TPU hot path, and each host seeds from its process
+index so global batches are disjoint under data parallelism.
+"""
+
+from .registry import DataSpec, build_data, register_dataset, registered_datasets  # noqa: F401
+from . import synthetic  # noqa: F401  (registers pipelines)

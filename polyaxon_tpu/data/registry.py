@@ -1,0 +1,54 @@
+"""Dataset registry, mirroring models/registry.py."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+_DATASETS: dict[str, Callable[..., "DataSpec"]] = {}
+
+
+@dataclasses.dataclass
+class DataSpec:
+    """A built pipeline: `iterator` yields dict batches forever; `batch_size`
+    is the per-host batch (global batch / process_count)."""
+
+    name: str
+    iterator: Iterator[dict[str, Any]]
+    batch_size: int
+    meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def register_dataset(name: str):
+    def deco(fn):
+        _DATASETS[name] = fn
+        return fn
+
+    return deco
+
+
+def build_data(
+    name: str,
+    batch_size: int,
+    config: Optional[dict] = None,
+    *,
+    seed: int = 0,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> DataSpec:
+    if name not in _DATASETS:
+        raise ValueError(f"unknown dataset {name!r}; registered: {sorted(_DATASETS)}")
+    if batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by {process_count} hosts"
+        )
+    return _DATASETS[name](
+        batch_size=batch_size // process_count,
+        config=dict(config or {}),
+        seed=seed,
+        process_index=process_index,
+    )
+
+
+def registered_datasets() -> list[str]:
+    return sorted(_DATASETS)

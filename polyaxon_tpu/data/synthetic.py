@@ -1,0 +1,131 @@
+"""Procedural datasets: learnable classification images and token streams.
+
+Classification sets draw each example as `prototype[label] + noise`, so a
+model that learns the prototypes drives loss to ~0 — tests assert descent.
+Token sets emit sequences from a fixed bigram chain, so a language model
+beats uniform loss quickly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import DataSpec, register_dataset
+
+
+def _class_image_stream(
+    shape, num_classes, batch_size, seed, process_index, noise=0.3
+):
+    rng = np.random.default_rng(seed * 1000003 + process_index)
+    protos = np.random.default_rng(seed).normal(size=(num_classes, *shape)).astype(
+        np.float32
+    )
+    while True:
+        labels = rng.integers(0, num_classes, size=(batch_size,))
+        x = protos[labels] + noise * rng.normal(size=(batch_size, *shape)).astype(
+            np.float32
+        )
+        yield {"inputs": x.astype(np.float32), "labels": labels.astype(np.int32)}
+
+
+@register_dataset("synthetic")
+def synthetic(batch_size, config, seed, process_index):
+    shape = tuple(config.get("shape", (32,)))
+    num_classes = int(config.get("num_classes", 10))
+    return DataSpec(
+        name="synthetic",
+        iterator=_class_image_stream(shape, num_classes, batch_size, seed, process_index),
+        batch_size=batch_size,
+        meta={"shape": shape, "num_classes": num_classes},
+    )
+
+
+@register_dataset("mnist")
+def mnist(batch_size, config, seed, process_index):
+    """MNIST-shaped (784-dim flat or 28x28x1) learnable stand-in: the real
+    archive is unreachable (zero egress), and BASELINE config #1 only needs a
+    pipeline with MNIST's schema whose loss descends."""
+    flat = bool(config.get("flat", True))
+    shape = (784,) if flat else (28, 28, 1)
+    return DataSpec(
+        name="mnist",
+        iterator=_class_image_stream(shape, 10, batch_size, seed, process_index),
+        batch_size=batch_size,
+        meta={"shape": shape, "num_classes": 10},
+    )
+
+
+@register_dataset("synthetic_imagenet")
+def synthetic_imagenet(batch_size, config, seed, process_index):
+    """ImageNet-shaped stream for ResNet/ViT throughput runs (config #2/#4)."""
+    size = int(config.get("image_size", 224))
+    num_classes = int(config.get("num_classes", 1000))
+    shape = (size, size, 3)
+    return DataSpec(
+        name="synthetic_imagenet",
+        iterator=_class_image_stream(
+            shape, num_classes, batch_size, seed, process_index, noise=1.0
+        ),
+        batch_size=batch_size,
+        meta={"shape": shape, "num_classes": num_classes},
+    )
+
+
+def _bigram_stream(batch_size, seq_len, vocab, seed, process_index, mlm, mask_rate):
+    chain_rng = np.random.default_rng(seed)
+    # peaked bigram transition table: each token has ~8 likely successors
+    logits = chain_rng.normal(size=(vocab, vocab)).astype(np.float32)
+    top = np.argsort(logits, axis=1)[:, -8:]
+    probs = np.full((vocab, vocab), 1e-4, np.float64)
+    for i in range(vocab):
+        probs[i, top[i]] += 1.0
+    probs /= probs.sum(axis=1, keepdims=True)
+    cdf = probs.cumsum(axis=1)
+    rng = np.random.default_rng(seed * 1000003 + process_index + 1)
+    while True:
+        toks = np.empty((batch_size, seq_len + 1), np.int64)
+        toks[:, 0] = rng.integers(0, vocab, size=batch_size)
+        u = rng.random((batch_size, seq_len))
+        for t in range(seq_len):
+            rows = cdf[toks[:, t]]
+            toks[:, t + 1] = (rows < u[:, t : t + 1]).sum(axis=1)
+        if mlm:
+            inputs = toks[:, :-1].copy()
+            labels = np.full_like(inputs, -100)
+            mask = rng.random(inputs.shape) < mask_rate
+            mask[:, 0] = True  # ≥1 masked position per row keeps loss defined
+            labels[mask] = inputs[mask]
+            inputs[mask] = 1  # [MASK] token id
+            yield {"inputs": inputs.astype(np.int32), "labels": labels.astype(np.int32)}
+        else:
+            yield {
+                "inputs": toks[:, :-1].astype(np.int32),
+                "labels": toks[:, 1:].astype(np.int32),
+            }
+
+
+@register_dataset("synthetic_text")
+def synthetic_text(batch_size, config, seed, process_index):
+    """Causal-LM token stream (Llama configs): inputs + next-token labels."""
+    seq_len = int(config.get("seq_len", 512))
+    vocab = int(config.get("vocab_size", 32000))
+    return DataSpec(
+        name="synthetic_text",
+        iterator=_bigram_stream(batch_size, seq_len, vocab, seed, process_index, False, 0.0),
+        batch_size=batch_size,
+        meta={"seq_len": seq_len, "vocab_size": vocab},
+    )
+
+
+@register_dataset("synthetic_mlm")
+def synthetic_mlm(batch_size, config, seed, process_index):
+    """Masked-LM stream (BERT config #3): 15% positions masked to id 1."""
+    seq_len = int(config.get("seq_len", 128))
+    vocab = int(config.get("vocab_size", 30522))
+    mask_rate = float(config.get("mask_rate", 0.15))
+    return DataSpec(
+        name="synthetic_mlm",
+        iterator=_bigram_stream(batch_size, seq_len, vocab, seed, process_index, True, mask_rate),
+        batch_size=batch_size,
+        meta={"seq_len": seq_len, "vocab_size": vocab},
+    )

@@ -1,0 +1,9 @@
+"""Model zoo (flax). Importing this package registers all builders."""
+
+from .registry import (  # noqa: F401
+    ModelBundle,
+    build_model,
+    register,
+    registered_models,
+)
+from . import mlp  # noqa: F401

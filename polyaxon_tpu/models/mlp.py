@@ -1,0 +1,49 @@
+"""MLP — BASELINE config #1 (MNIST) model; also the smoke-test model for the
+runtime. Kept dense-only so the whole forward is MXU matmuls."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import ModelBundle, f32_images, register
+
+
+class MLP(nn.Module):
+    hidden: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, width in enumerate(self.hidden):
+            x = nn.Dense(width, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if self.dropout_rate:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+
+
+@register("mlp")
+def build_mlp(config: dict) -> ModelBundle:
+    input_dim = int(config.pop("input_dim", 784))
+    module = MLP(
+        hidden=tuple(config.get("hidden", (512, 256))),
+        num_classes=int(config.get("num_classes", 10)),
+        dropout_rate=float(config.get("dropout_rate", 0.0)),
+    )
+    return ModelBundle(
+        name="mlp",
+        module=module,
+        example_inputs=f32_images((input_dim,)),
+        # wide hidden layers shard their output dim over the model axis;
+        # fsdp shards the input dim (rules applied by parallel/sharding.py)
+        sharding_rules=(
+            (r"dense_\d+/kernel", ("fsdp", "model")),
+            (r"head/kernel", ("fsdp", None)),
+        ),
+    )

@@ -1,0 +1,75 @@
+"""Model registry: `program.model.name` → builder.
+
+The reference runs arbitrary user containers (SURVEY.md §1: training compute
+is not in-repo); the TPU rebuild owns the training loop, so models live here
+as flax modules selected by name from the Polyaxonfile `program:` block.
+
+A builder takes the `program.model.config` dict and returns a `ModelBundle`:
+the flax module plus everything the trainer needs to drive it generically
+(input synthesis for init, loss selection, logical-axis sharding rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_REGISTRY: dict[str, Callable[[dict], "ModelBundle"]] = {}
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    """Everything the generic trainer needs about a model.
+
+    - `module`: the flax module; `__call__(batch_inputs, train=...)` → logits.
+    - `example_inputs(batch_size)`: abstract/concrete inputs for `init` and
+      shape inference — static shapes so XLA compiles once.
+    - `loss`: default loss name (ops/losses.py) if the train spec doesn't pick.
+    - `sharding_rules`: (param-path-regex, PartitionSpec-axes) pairs consumed
+      by parallel/sharding.py; axes name *logical* mesh axes ("model", "fsdp",
+      None) so one rule set serves any mesh shape.
+    - `task`: "classification" | "mlm" | "lm" — selects batch schema.
+    """
+
+    name: str
+    module: nn.Module
+    example_inputs: Callable[[int], Any]
+    loss: str = "softmax_cross_entropy"
+    sharding_rules: tuple = ()
+    task: str = "classification"
+    rngs: tuple[str, ...] = ("dropout",)
+
+
+def register(name: str):
+    def deco(fn: Callable[[dict], ModelBundle]):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def build_model(name: str, config: Optional[dict] = None) -> ModelBundle:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](dict(config or {}))
+
+
+def registered_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def f32_images(shape: tuple[int, ...]):
+    def make(batch_size: int):
+        return jnp.zeros((batch_size, *shape), jnp.float32)
+
+    return make
+
+
+def i32_tokens(seq_len: int):
+    def make(batch_size: int):
+        return jnp.zeros((batch_size, seq_len), jnp.int32)
+
+    return make

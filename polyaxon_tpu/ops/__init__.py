@@ -1,0 +1,2 @@
+from .losses import accuracy, build_loss, register_loss  # noqa: F401
+from .optimizers import build_optimizer, build_schedule  # noqa: F401

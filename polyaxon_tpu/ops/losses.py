@@ -1,0 +1,75 @@
+"""Loss registry. All losses take (logits, batch) and return a scalar f32 —
+computed in float32 regardless of compute dtype: reductions on bf16
+accumulate error, and the scalar is HBM-free anyway.
+
+Batch schema: dict with "inputs" plus task-specific targets:
+  classification: "labels" int32 [B]
+  mlm:            "labels" int32 [B,S] with -100 = unmasked (ignored)
+  lm:             "labels" int32 [B,S] shifted next-token targets, -100 pad
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import optax
+
+_LOSSES: dict[str, Callable] = {}
+
+
+def register_loss(name: str):
+    def deco(fn):
+        _LOSSES[name] = fn
+        return fn
+
+    return deco
+
+
+def build_loss(name: str) -> Callable:
+    if name not in _LOSSES:
+        raise ValueError(f"unknown loss {name!r}; registered: {sorted(_LOSSES)}")
+    return _LOSSES[name]
+
+
+@register_loss("softmax_cross_entropy")
+def softmax_cross_entropy(logits, batch):
+    labels = batch["labels"]
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    )
+    return losses.mean()
+
+
+@register_loss("masked_lm")
+def masked_lm(logits, batch):
+    """Cross entropy over positions with label != -100 (BERT MLM / causal LM).
+
+    The mask trick keeps shapes static (no boolean gather) so XLA fuses the
+    whole thing into the final matmul's epilogue.
+    """
+    labels = batch["labels"]
+    mask = (labels != -100).astype(jnp.float32)
+    safe = jnp.where(labels == -100, 0, labels)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), safe
+    )
+    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+@register_loss("mse")
+def mse(logits, batch):
+    target = batch["labels"].astype(jnp.float32)
+    return jnp.mean((logits.astype(jnp.float32) - target) ** 2)
+
+
+def accuracy(logits, batch) -> jnp.ndarray:
+    """Classification accuracy metric (not a loss)."""
+    labels = batch["labels"]
+    pred = jnp.argmax(logits, axis=-1)
+    if labels.ndim == pred.ndim:  # token-level with ignore index
+        mask = (labels != -100).astype(jnp.float32)
+        return ((pred == labels).astype(jnp.float32) * mask).sum() / jnp.maximum(
+            mask.sum(), 1.0
+        )
+    return (pred == labels).astype(jnp.float32).mean()

@@ -1,0 +1,90 @@
+"""Optimizer + LR-schedule factory for `program.optimizer`.
+
+Builds an `optax.GradientTransformation` from the Polyaxonfile spec:
+  optimizer: {name: adamw, learningRate: 3e-4,
+              config: {weight_decay: 0.01}, schedule: {name: cosine, ...}}
+
+Everything is pure optax — state is a pytree, so it shards/checkpoints with
+the params under the same partitioning rules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import optax
+
+_OPTIMIZERS: dict[str, Callable[..., optax.GradientTransformation]] = {
+    "sgd": optax.sgd,
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "lamb": optax.lamb,
+    "lion": optax.lion,
+    "adafactor": optax.adafactor,
+    "rmsprop": optax.rmsprop,
+    "adagrad": optax.adagrad,
+}
+
+
+def build_schedule(
+    base_lr: float, spec: Optional[dict[str, Any]], total_steps: int
+) -> optax.Schedule:
+    """schedule: {name: cosine|linear|constant|rsqrt|step, warmup_steps: N, ...}"""
+    if not spec:
+        return optax.constant_schedule(base_lr)
+    spec = dict(spec)
+    name = spec.pop("name", "constant")
+    warmup = int(spec.pop("warmup_steps", 0))
+    decay_steps = max(1, int(spec.pop("decay_steps", total_steps)) - warmup)
+    if name == "constant":
+        sched = optax.constant_schedule(base_lr)
+    elif name == "cosine":
+        sched = optax.cosine_decay_schedule(
+            base_lr, decay_steps, alpha=float(spec.pop("alpha", 0.0))
+        )
+    elif name == "linear":
+        sched = optax.linear_schedule(
+            base_lr, float(spec.pop("end_value", 0.0)), decay_steps
+        )
+    elif name == "rsqrt":
+        # rsqrt decay from the warmup point, classic transformer schedule
+        shift = max(warmup, 1)
+        sched = lambda step: base_lr * (shift**0.5) / ((step + shift) ** 0.5)  # noqa: E731
+    elif name == "step":
+        boundaries = spec.pop("boundaries", [])
+        scales = spec.pop("scales", [0.1] * len(boundaries))
+        sched = optax.piecewise_constant_schedule(
+            base_lr, {int(b): float(s) for b, s in zip(boundaries, scales)}
+        )
+    elif name == "exponential":
+        sched = optax.exponential_decay(
+            base_lr,
+            decay_steps,
+            float(spec.pop("decay_rate", 0.96)),
+            staircase=bool(spec.pop("staircase", False)),
+        )
+    else:
+        raise ValueError(f"unknown schedule {name!r}")
+    if warmup > 0:
+        sched = optax.join_schedules(
+            [optax.linear_schedule(0.0, base_lr, warmup), sched], [warmup]
+        )
+    return sched
+
+
+def build_optimizer(
+    name: str = "adamw",
+    learning_rate: float = 1e-3,
+    config: Optional[dict[str, Any]] = None,
+    schedule: Optional[dict[str, Any]] = None,
+    total_steps: int = 1000,
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    if name not in _OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {name!r}; one of {sorted(_OPTIMIZERS)}")
+    config = dict(config or {})
+    grad_clip = config.pop("grad_clip_norm", None)
+    sched = build_schedule(float(learning_rate), schedule, total_steps)
+    tx = _OPTIMIZERS[name](learning_rate=sched, **config)
+    if grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(float(grad_clip)), tx)
+    return tx, sched

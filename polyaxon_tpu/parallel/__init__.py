@@ -1,0 +1,7 @@
+from .mesh import AXIS_ORDER, BATCH_AXES, build_mesh, resolve_axis_sizes  # noqa: F401
+from .sharding import (  # noqa: F401
+    batch_sharding,
+    make_global_batch,
+    param_shardings,
+    replicated,
+)

@@ -1,0 +1,70 @@
+"""Device-mesh construction from the Polyaxonfile `mesh:` block.
+
+Replaces the reference's NCCL/MPI rendezvous wiring (SURVEY.md §5: env-var
+plumbing like TF_CONFIG/MASTER_ADDR was the reference's whole comm backend)
+with a `jax.sharding.Mesh`: axes named data/fsdp/model/pipeline/context/
+expert; XLA chooses ICI vs DCN collectives from device placement.
+
+Axis order is fixed so that the innermost axes (model, context) map to
+adjacent devices — tensor-parallel and ring collectives then ride
+nearest-neighbor ICI links instead of hopping the torus.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# outer→inner: DCN-tolerant axes first, latency-critical axes innermost
+AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "context", "model")
+
+# batch-sharded axes: the global batch dim is split across these
+BATCH_AXES = ("data", "fsdp")
+
+
+def resolve_axis_sizes(
+    spec_sizes: Optional[dict[str, int]], n_devices: int
+) -> dict[str, int]:
+    """Fill the -1 axis, default to pure DP, validate the product."""
+    sizes = dict(spec_sizes or {})
+    if not sizes:
+        sizes = {"data": n_devices}
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    fill_axes = [k for k, v in sizes.items() if v == -1]
+    if fill_axes:
+        if n_devices % fixed != 0:
+            raise ValueError(f"mesh {sizes} does not divide {n_devices} devices")
+        sizes[fill_axes[0]] = n_devices // fixed
+    elif fixed != n_devices:
+        raise ValueError(
+            f"mesh {sizes} multiplies to {fixed}, but {n_devices} devices present"
+        )
+    return {ax: sizes[ax] for ax in AXIS_ORDER if ax in sizes}
+
+
+def build_mesh(
+    spec_sizes: Optional[dict[str, int]] = None,
+    devices: Optional[list] = None,
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    sizes = resolve_axis_sizes(spec_sizes, len(devices))
+    try:
+        # mesh_utils knows the physical ICI topology (it reads device coords)
+        # and lays logical axes onto it to keep inner axes on adjacent chips
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            tuple(sizes.values()), devices=devices
+        )
+    except Exception:
+        dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def local_batch_slice(mesh: Mesh) -> int:
+    """How many ways the batch dimension is split on this mesh."""
+    return math.prod(mesh.shape.get(ax, 1) for ax in BATCH_AXES)

@@ -1,0 +1,1 @@
+from .executor import ExecutionError, Executor  # noqa: F401

@@ -1,0 +1,78 @@
+"""Checkpoint/resume via Orbax: async, multi-host-safe, sharding-aware.
+
+The reference handles resume at the platform level (run restart/copy
+inherits the outputs path — SURVEY.md §5); in-training checkpointing was
+user-code. Here it is built in: the trainer saves TrainState every
+`checkpoint_every` steps into the run's artifacts dir, and `resume: true`
+(or a restarted run) picks up the latest step. Saves are async — device
+arrays are snapshotted, then written in the background without stalling
+the step loop; `wait=True` barriers at the end of the run.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+
+_manager_lock = threading.Lock()
+_managers: dict[str, object] = {}
+
+
+def _manager(directory: str):
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    with _manager_lock:
+        mgr = _managers.get(directory)
+        if mgr is None:
+            mgr = ocp.CheckpointManager(
+                directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=3, enable_async_checkpointing=True
+                ),
+            )
+            _managers[directory] = mgr
+        return mgr
+
+
+def save_checkpoint(directory: str, step: int, state, *, wait: bool = False):
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    if wait:
+        mgr.wait_until_finished()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not directory or not os.path.isdir(directory):
+        return None
+    return _manager(directory).latest_step()
+
+
+def restore_checkpoint(directory: str, step: int, target):
+    """Restore into the sharding/structure of `target` (the freshly built
+    state) so arrays land directly on their mesh devices."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array)
+        else x,
+        target,
+    )
+    return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+
+def close_all():
+    with _manager_lock:
+        for mgr in _managers.values():
+            try:
+                mgr.close()
+            except Exception:
+                pass
+        _managers.clear()

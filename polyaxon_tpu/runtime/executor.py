@@ -1,0 +1,193 @@
+"""Local executor: CompiledOperation → a run in the store, executed.
+
+This is the in-process execution path (SURVEY.md §7 step 2) — the analogue
+of stack (a) in §3 with the control plane collapsed to the local store:
+create run → status transitions (compiled→…→running→succeeded/failed) →
+execute (native program via runtime/trainer.py, or a container command as a
+local subprocess) → metrics/logs into the store.
+
+The same Executor is reused by the scheduler's worker and by the tuner for
+child trials; only the process placement differs.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import subprocess
+import sys
+import time
+import traceback
+from typing import Optional
+
+from ..compiler.resolver import CompiledOperation
+from ..schemas.lifecycle import V1Statuses
+from ..store.local import RunStore
+
+
+class ExecutionError(Exception):
+    pass
+
+
+class Executor:
+    def __init__(self, store: Optional[RunStore] = None, devices: Optional[list] = None):
+        self.store = store or RunStore()
+        self.devices = devices
+
+    def execute(self, compiled: CompiledOperation) -> str:
+        """Run to completion; returns final status. Retries per termination
+        spec (maxRetries) — restart-from-checkpoint comes free because the
+        trainer resumes from the run's outputs dir."""
+        store = self.store
+        run_uuid = compiled.run_uuid
+        store.create_run(
+            run_uuid,
+            compiled.name,
+            compiled.project,
+            compiled.to_dict(),
+            tags=compiled.operation.tags,
+        )
+        store.set_status(run_uuid, V1Statuses.COMPILED)
+        store.set_status(run_uuid, V1Statuses.QUEUED)
+        store.set_status(run_uuid, V1Statuses.SCHEDULED)
+
+        term = compiled.component.termination
+        max_retries = (term.max_retries if term and term.max_retries else 0) or 0
+        timeout = term.timeout if term else None
+
+        attempt = 0
+        while True:
+            store.set_status(run_uuid, V1Statuses.STARTING)
+            try:
+                self._run_once(compiled, timeout=timeout, resume=attempt > 0)
+                store.set_status(run_uuid, V1Statuses.SUCCEEDED)
+                return V1Statuses.SUCCEEDED
+            except BaseException as e:  # noqa: BLE001 — record, then decide
+                store.append_log(run_uuid, f"ERROR: {e}\n{traceback.format_exc()}")
+                if isinstance(e, KeyboardInterrupt):
+                    store.set_status(run_uuid, V1Statuses.STOPPING)
+                    store.set_status(run_uuid, V1Statuses.STOPPED)
+                    raise
+                if attempt < max_retries:
+                    attempt += 1
+                    store.set_status(run_uuid, V1Statuses.RETRYING, reason=str(e))
+                    store.set_status(run_uuid, V1Statuses.QUEUED)
+                    store.set_status(run_uuid, V1Statuses.SCHEDULED)
+                    continue
+                store.set_status(
+                    run_uuid, V1Statuses.FAILED, reason=type(e).__name__, message=str(e)
+                )
+                return V1Statuses.FAILED
+
+    # ------------------------------------------------------------------
+    def _run_once(self, compiled: CompiledOperation, timeout=None, resume=False):
+        run = compiled.run
+        run_uuid = compiled.run_uuid
+        store = self.store
+        if run.kind == "jaxjob" and run.program is not None:
+            self._run_program(compiled, resume=resume)
+        elif run.kind in ("job", "jaxjob", "service") and run.container is not None:
+            self._run_container(compiled, timeout=timeout)
+        elif run.kind == "dag":
+            from ..scheduler.dag import execute_dag
+
+            store.set_status(run_uuid, V1Statuses.RUNNING)
+            execute_dag(compiled, self)
+        else:
+            raise ExecutionError(f"cannot execute run kind {run.kind!r} locally")
+
+    def _run_program(self, compiled: CompiledOperation, resume: bool):
+        from .trainer import Trainer
+
+        run = compiled.run
+        store, run_uuid = self.store, compiled.run_uuid
+        mesh_axes = run.mesh.axis_sizes() if run.mesh else None
+
+        def log_fn(step: int, metrics: dict):
+            store.log_metrics(run_uuid, step, metrics)
+            line = f"step {step}: " + " ".join(
+                f"{k}={v:.6g}" for k, v in metrics.items()
+            )
+            store.append_log(run_uuid, line)
+
+        ckpt_dir = None
+        tspec = run.program.train
+        if tspec and (tspec.checkpoint_every or tspec.resume):
+            ckpt_dir = str(store.outputs_dir(run_uuid) / "checkpoints")
+        program = run.program
+        if resume and ckpt_dir is None:
+            # retry without explicit checkpointing: restart from scratch
+            pass
+        if resume and tspec is not None:
+            program = program.model_copy(
+                update={"train": tspec.model_copy(update={"resume": True})}
+            )
+        trainer = Trainer(
+            program,
+            mesh_axes=mesh_axes,
+            devices=self.devices,
+            log_fn=log_fn,
+            checkpoint_dir=ckpt_dir,
+        )
+        store.set_status(run_uuid, V1Statuses.RUNNING)
+        result = trainer.run()
+        store.log_event(
+            run_uuid,
+            "run_summary",
+            {
+                "steps_per_sec": result.steps_per_sec,
+                "final_metrics": result.final_metrics,
+            },
+        )
+        store.append_log(
+            run_uuid,
+            f"done: {result.steps_per_sec:.2f} steps/s, "
+            f"final {result.final_metrics}",
+        )
+
+    def _run_container(self, compiled: CompiledOperation, timeout=None):
+        """Local-subprocess stand-in for the k8s pod path: runs the container
+        command on this host (image is ignored locally; the k8s converter in
+        scheduler/converter.py is the cluster path)."""
+        run = compiled.run
+        store, run_uuid = self.store, compiled.run_uuid
+        c = run.container
+        cmd = list(c.command or []) + list(c.args or [])
+        if not cmd:
+            raise ExecutionError("container has no command")
+        env = dict(os.environ)
+        env.update(_context_env(compiled, store))
+        if isinstance(c.env, dict):
+            env.update({k: str(v) for k, v in c.env.items()})
+        elif isinstance(c.env, list):
+            env.update({e["name"]: str(e.get("value", "")) for e in c.env})
+        store.set_status(run_uuid, V1Statuses.RUNNING)
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=c.working_dir or None,
+            env=env,
+        )
+        deadline = time.time() + timeout if timeout else None
+        for line in iter(proc.stdout.readline, ""):
+            store.append_log(run_uuid, line.rstrip("\n"))
+            if deadline and time.time() > deadline:
+                proc.kill()
+                raise ExecutionError(f"run exceeded timeout of {timeout}s")
+        code = proc.wait()
+        if code != 0:
+            raise ExecutionError(f"container command exited with code {code}")
+
+
+def _context_env(compiled: CompiledOperation, store: RunStore) -> dict[str, str]:
+    """Env the reference's converter injects into pods (run identity + paths),
+    which the tracking client (tracking/run.py) reads to auto-attach."""
+    return {
+        "POLYAXON_RUN_UUID": compiled.run_uuid,
+        "POLYAXON_RUN_NAME": compiled.name,
+        "POLYAXON_PROJECT": compiled.project,
+        "POLYAXON_RUN_OUTPUTS_PATH": str(store.outputs_dir(compiled.run_uuid)),
+        "POLYAXON_HOME": str(store.home),
+    }

@@ -1,0 +1,308 @@
+"""The JAXJob training loop: a concrete `V1Program` → trained state.
+
+This is the compute the reference never owned (SURVEY.md §1: training lived
+in user containers behind Kubeflow CRDs). TPU-first design decisions:
+
+- ONE jit-compiled `train_step` (params donated, static shapes) — the Python
+  loop only feeds batches and reads metrics on log steps, so steps between
+  logs run back-to-back on device with no host sync.
+- Mixed precision the TPU way: params in f32, compute in bf16 (MXU-native);
+  no loss scaling — bf16 keeps f32's exponent range.
+- Sharding via NamedShardings from model-declared logical rules
+  (parallel/sharding.py); init runs under jit with `out_shardings`, so params
+  materialize directly on their devices — no host-side full copy.
+- Optional `jax.checkpoint` (remat) over the model apply to trade FLOPs for
+  HBM when activations don't fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+
+from ..data import build_data
+from ..models import build_model
+from ..ops.losses import accuracy as accuracy_metric
+from ..ops.losses import build_loss
+from ..ops.optimizers import build_optimizer
+from ..parallel.mesh import build_mesh, local_batch_slice
+from ..parallel.sharding import (
+    batch_sharding,
+    make_global_batch,
+    param_shardings,
+    replicated,
+)
+from ..schemas.run_kinds import V1Program
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class TrainResult:
+    state: TrainState
+    history: list[dict]
+    steps_per_sec: float
+    final_metrics: dict
+
+
+def _compute_dtype(precision: str):
+    return {"float32": jnp.float32, "mixed": jnp.bfloat16, "bfloat16": jnp.bfloat16}[
+        precision
+    ]
+
+
+def _cast_floats(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+class Trainer:
+    """Drives one program on one mesh. Multi-host setup (jax.distributed)
+    happens in the executor before this class is built."""
+
+    def __init__(
+        self,
+        program: V1Program,
+        *,
+        mesh_axes: Optional[dict[str, int]] = None,
+        devices: Optional[list] = None,
+        log_fn: Optional[Callable[[int, dict], None]] = None,
+        checkpoint_dir: Optional[str] = None,
+    ):
+        self.program = program
+        tspec = program.train
+        if tspec is None:
+            from ..schemas.run_kinds import V1TrainSpec
+
+            tspec = V1TrainSpec()
+        self.tspec = tspec
+        self.log_fn = log_fn or (lambda step, m: None)
+        self.checkpoint_dir = checkpoint_dir
+
+        self.bundle = build_model(program.model.name, program.model.config)
+        dspec = program.data
+        data_name = dspec.name if dspec else "synthetic"
+        batch_size = int(dspec.batch_size) if dspec else 32
+        self.data = build_data(
+            data_name,
+            batch_size,
+            dspec.config if dspec else None,
+            seed=int(tspec.seed),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+        ospec = program.optimizer
+        self.steps = int(tspec.steps)
+        self.tx, self.sched = build_optimizer(
+            name=ospec.name if ospec else "adamw",
+            learning_rate=float(ospec.learning_rate) if ospec else 1e-3,
+            config=ospec.config if ospec else None,
+            schedule=ospec.schedule if ospec else None,
+            total_steps=self.steps,
+        )
+        self.loss_fn = build_loss(tspec.loss or self.bundle.loss)
+        self.mesh = build_mesh(mesh_axes, devices=devices)
+        self.compute_dtype = _compute_dtype(tspec.precision)
+        self.param_dtype = (
+            jnp.bfloat16 if tspec.precision == "bfloat16" else jnp.float32
+        )
+        self._build_step()
+
+    # -------------------------------------------------------------- setup
+    def _build_step(self):
+        bundle, mesh, tspec = self.bundle, self.mesh, self.tspec
+        global_batch = self.data.batch_size * jax.process_count()
+        if global_batch % local_batch_slice(mesh) != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by batch-sharded "
+                f"mesh axes ({local_batch_slice(mesh)})"
+            )
+        example = bundle.example_inputs(global_batch)
+        init_rng = jax.random.PRNGKey(int(tspec.seed))
+
+        def init_fn(rng):
+            variables = bundle.module.init(
+                {"params": rng, **{k: rng for k in bundle.rngs}},
+                example,
+                train=False,
+            )
+            params = variables["params"]
+            if self.param_dtype != jnp.float32:
+                params = _cast_floats(params, self.param_dtype)
+            return params
+
+        abstract_params = jax.eval_shape(init_fn, init_rng)
+        self.p_shard = param_shardings(abstract_params, bundle.sharding_rules, mesh)
+        o_shard = _opt_state_shardings(self.tx, abstract_params, self.p_shard, mesh)
+        params = jax.jit(init_fn, out_shardings=self.p_shard)(init_rng)
+        opt_state = jax.jit(self.tx.init, out_shardings=o_shard)(params)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        )
+        self.b_shard = batch_sharding(mesh)
+        rep = replicated(mesh)
+        state_shardings = TrainState(step=rep, params=self.p_shard, opt_state=o_shard)
+
+        compute_dtype = self.compute_dtype
+        loss_fn, tx, sched = self.loss_fn, self.tx, self.sched
+        use_remat = bool(tspec.remat)
+        is_classification = bundle.task == "classification"
+        seed = int(tspec.seed)
+
+        def apply(params, inputs, rng):
+            rngs = {k: jax.random.fold_in(rng, i) for i, k in enumerate(bundle.rngs)}
+            return bundle.module.apply(
+                {"params": params}, inputs, train=True, rngs=rngs
+            )
+
+        if use_remat:
+            apply = jax.checkpoint(apply)
+
+        param_dtype = self.param_dtype
+
+        def step_fn(state: TrainState, batch):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+
+            def loss_of(params):
+                compute_params = (
+                    _cast_floats(params, compute_dtype)
+                    if compute_dtype != param_dtype
+                    else params
+                )
+                inputs = batch["inputs"]
+                if jnp.issubdtype(inputs.dtype, jnp.floating):
+                    inputs = inputs.astype(compute_dtype)
+                logits = apply(compute_params, inputs, rng)
+                return loss_fn(logits, batch), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state.params
+            )
+            # grads come out in compute dtype; update math runs in param dtype
+            grads = _cast_floats(grads, param_dtype)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "learning_rate": jnp.asarray(sched(state.step), jnp.float32),
+                "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            }
+            if is_classification:
+                metrics["accuracy"] = accuracy_metric(logits, batch)
+            return (
+                TrainState(step=state.step + 1, params=params, opt_state=opt_state),
+                metrics,
+            )
+
+        donate = (0,) if tspec.donate_state else ()
+        self.train_step = jax.jit(
+            step_fn,
+            in_shardings=(state_shardings, self.b_shard),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=donate,
+        )
+
+    # -------------------------------------------------------------- loop
+    def run(self) -> TrainResult:
+        tspec = self.tspec
+        log_every = max(1, int(tspec.log_every))
+        ckpt_every = int(tspec.checkpoint_every) if tspec.checkpoint_every else 0
+        start_step = 0
+        if self.checkpoint_dir and tspec.resume:
+            start_step = self.restore()
+        history: list[dict] = []
+        it = self.data.iterator
+        metrics = {}
+        pending: Optional[tuple[int, dict]] = None
+        t0 = time.perf_counter()
+        for step in range(start_step, self.steps):
+            batch = make_global_batch(next(it), self.mesh, self.b_shard)
+            self.state, metrics = self.train_step(self.state, batch)
+            if (step + 1) % log_every == 0 or step + 1 == self.steps:
+                # flush the previous log point first: keeps one step of
+                # pipelining so logging never stalls the device queue
+                if pending is not None:
+                    self._emit(history, *pending)
+                pending = (step + 1, metrics)
+            if ckpt_every and (step + 1) % ckpt_every == 0:
+                self.save(step + 1)
+        if pending is not None:
+            self._emit(history, *pending)
+        elapsed = time.perf_counter() - t0
+        steps_done = self.steps - start_step
+        sps = steps_done / elapsed if elapsed > 0 else 0.0
+        if self.checkpoint_dir and ckpt_every:
+            self.save(self.steps, wait=True)
+        final = dict(history[-1]) if history else {}
+        final["steps_per_sec"] = sps
+        final["examples_per_sec"] = sps * self.data.batch_size * jax.process_count()
+        return TrainResult(
+            state=self.state, history=history, steps_per_sec=sps, final_metrics=final
+        )
+
+    def _emit(self, history, step, metrics):
+        vals = {k: float(v) for k, v in metrics.items()}
+        history.append({"step": step, **vals})
+        self.log_fn(step, vals)
+
+    # -------------------------------------------------------------- ckpt
+    def save(self, step: int, wait: bool = False):
+        from .checkpoint import save_checkpoint
+
+        save_checkpoint(self.checkpoint_dir, step, self.state, wait=wait)
+
+    def restore(self) -> int:
+        from .checkpoint import latest_step, restore_checkpoint
+
+        step = latest_step(self.checkpoint_dir)
+        if step is None:
+            return 0
+        self.state = restore_checkpoint(self.checkpoint_dir, step, self.state)
+        return step
+
+
+def _opt_state_shardings(tx, params, p_shard, mesh):
+    """Optimizer state shards like the params it mirrors. Moment trees embed
+    the param path in their own leaf paths (e.g. `0/mu/dense_0/kernel`), so
+    the model's regex rules apply transitively; scalar leaves (step counts)
+    fall through to replication."""
+    from ..parallel.sharding import param_shardings as _ps
+
+    shape = jax.eval_shape(tx.init, params)
+    rules = _rules_from(p_shard)
+    return _ps(shape, rules, mesh)
+
+
+def _rules_from(p_shard):
+    """Recover (path-regex, axes) rules from a resolved param-sharding tree —
+    exact escaped paths anchored at the end, so moment-tree prefixes match."""
+    import re as _re
+
+    rules = []
+    def add(path, sh):
+        from ..parallel.sharding import _path_str
+
+        axes = tuple(
+            ax if not isinstance(ax, tuple) else ax for ax in (sh.spec or ())
+        )
+        if any(a is not None for a in axes):
+            rules.append((_re.escape(_path_str(path)) + "$", axes))
+        return sh
+
+    jax.tree_util.tree_map_with_path(add, p_shard)
+    return tuple(rules)

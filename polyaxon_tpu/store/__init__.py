@@ -1,0 +1,1 @@
+from .local import RunStore, polyaxon_home  # noqa: F401

@@ -1,0 +1,204 @@
+"""Local run store: the filesystem-backed equivalent of the reference's
+control-plane DB + artifact store (SURVEY.md §2 "Control plane (haupt)" /
+"Connections/fs", rebuilt thin and local-first).
+
+Layout under $POLYAXON_HOME (default ~/.polyaxon):
+  runs/<uuid>/spec.json      compiled operation (concrete, post-interpolation)
+  runs/<uuid>/status.json    lifecycle status + condition history
+  runs/<uuid>/metrics.jsonl  one JSON line per logged step
+  runs/<uuid>/events.jsonl   non-metric tracked events (artifacts refs, ...)
+  runs/<uuid>/logs.txt       captured run logs
+  runs/<uuid>/outputs/       artifacts root (checkpoints/, profiler/, ...)
+  index.jsonl                append-only run registry
+
+Writes are single-writer-per-run and append-only where possible, so a
+sidecar/streams service can tail them without coordination.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+from ..schemas.lifecycle import V1Statuses, can_transition, is_done
+
+
+def polyaxon_home() -> Path:
+    return Path(os.environ.get("POLYAXON_HOME", Path.home() / ".polyaxon"))
+
+
+class RunStore:
+    def __init__(self, home: Optional[Path | str] = None):
+        self.home = Path(home) if home else polyaxon_home()
+        self.runs_dir = self.home / "runs"
+        self.runs_dir.mkdir(parents=True, exist_ok=True)
+
+    # ----------------------------------------------------------- creation
+    def create_run(
+        self,
+        run_uuid: str,
+        name: str,
+        project: str,
+        spec: dict[str, Any],
+        *,
+        tags: Optional[list[str]] = None,
+        meta: Optional[dict] = None,
+    ) -> Path:
+        run_dir = self.run_dir(run_uuid)
+        run_dir.mkdir(parents=True, exist_ok=True)
+        (run_dir / "outputs").mkdir(exist_ok=True)
+        _write_json(run_dir / "spec.json", spec)
+        _write_json(
+            run_dir / "status.json",
+            {
+                "uuid": run_uuid,
+                "status": V1Statuses.CREATED,
+                "conditions": [_condition(V1Statuses.CREATED)],
+                "meta": meta or {},
+            },
+        )
+        with (self.home / "index.jsonl").open("a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "uuid": run_uuid,
+                        "name": name,
+                        "project": project,
+                        "tags": tags or [],
+                        "created_at": time.time(),
+                    }
+                )
+                + "\n"
+            )
+        return run_dir
+
+    def run_dir(self, run_uuid: str) -> Path:
+        return self.runs_dir / run_uuid
+
+    def outputs_dir(self, run_uuid: str) -> Path:
+        return self.run_dir(run_uuid) / "outputs"
+
+    # ----------------------------------------------------------- status
+    def set_status(
+        self, run_uuid: str, status: str, reason: str = "", message: str = ""
+    ):
+        path = self.run_dir(run_uuid) / "status.json"
+        data = _read_json(path) or {"uuid": run_uuid, "conditions": []}
+        current = data.get("status")
+        if current and not can_transition(V1Statuses(current), V1Statuses(status)):
+            raise ValueError(f"illegal status transition {current} → {status}")
+        data["status"] = status
+        data["conditions"].append(_condition(status, reason, message))
+        _write_json(path, data)
+
+    def get_status(self, run_uuid: str) -> dict:
+        return _read_json(self.run_dir(run_uuid) / "status.json") or {}
+
+    # ----------------------------------------------------------- events
+    def log_metrics(self, run_uuid: str, step: int, metrics: dict[str, float]):
+        line = json.dumps({"step": step, "ts": time.time(), **metrics})
+        with (self.run_dir(run_uuid) / "metrics.jsonl").open("a") as f:
+            f.write(line + "\n")
+
+    def log_event(self, run_uuid: str, kind: str, body: dict[str, Any]):
+        line = json.dumps({"kind": kind, "ts": time.time(), **body})
+        with (self.run_dir(run_uuid) / "events.jsonl").open("a") as f:
+            f.write(line + "\n")
+
+    def append_log(self, run_uuid: str, text: str):
+        with (self.run_dir(run_uuid) / "logs.txt").open("a") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+
+    # ----------------------------------------------------------- reads
+    def read_metrics(self, run_uuid: str) -> list[dict]:
+        return _read_jsonl(self.run_dir(run_uuid) / "metrics.jsonl")
+
+    def read_events(self, run_uuid: str) -> list[dict]:
+        return _read_jsonl(self.run_dir(run_uuid) / "events.jsonl")
+
+    def read_logs(self, run_uuid: str) -> str:
+        path = self.run_dir(run_uuid) / "logs.txt"
+        return path.read_text() if path.exists() else ""
+
+    def read_spec(self, run_uuid: str) -> dict:
+        return _read_json(self.run_dir(run_uuid) / "spec.json") or {}
+
+    def list_runs(self, project: Optional[str] = None) -> list[dict]:
+        out = []
+        for rec in _read_jsonl(self.home / "index.jsonl"):
+            if project and rec.get("project") != project:
+                continue
+            status = self.get_status(rec["uuid"])
+            rec["status"] = status.get("status", "unknown")
+            out.append(rec)
+        return out
+
+    def resolve(self, ref: str) -> str:
+        """uuid, unique uuid prefix, or run name → uuid (latest match wins)."""
+        runs = _read_jsonl(self.home / "index.jsonl")
+        exact = [r for r in runs if r["uuid"] == ref]
+        if exact:
+            return ref
+        by_prefix = [r for r in runs if r["uuid"].startswith(ref)]
+        if len({r["uuid"] for r in by_prefix}) == 1:
+            return by_prefix[0]["uuid"]
+        by_name = [r for r in runs if r.get("name") == ref]
+        if by_name:
+            return by_name[-1]["uuid"]
+        raise KeyError(f"no run matching {ref!r}")
+
+    def watch_logs(self, run_uuid: str, poll: float = 0.3) -> Iterator[str]:
+        """Tail logs until the run reaches a terminal status."""
+        path = self.run_dir(run_uuid) / "logs.txt"
+        pos = 0
+        while True:
+            if path.exists():
+                with path.open() as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    yield chunk
+            status = self.get_status(run_uuid).get("status", "")
+            try:
+                if is_done(V1Statuses(status)):
+                    break
+            except ValueError:
+                pass
+            time.sleep(poll)
+
+
+def _condition(status: str, reason: str = "", message: str = "") -> dict:
+    return {
+        "type": status,
+        "status": True,
+        "reason": reason,
+        "message": message,
+        "ts": time.time(),
+    }
+
+
+def _write_json(path: Path, data: dict):
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(data, indent=1, default=str))
+    os.replace(tmp, path)
+
+
+def _read_json(path: Path) -> Optional[dict]:
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def _read_jsonl(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line:
+            out.append(json.loads(line))
+    return out

@@ -1,0 +1,1 @@
+from .run import Run, end, get_or_create_run, init, log_metrics  # noqa: F401

@@ -1,0 +1,140 @@
+"""Tracking client — the traceml-equivalent (SURVEY.md §2 "Traceml",
+§3 stack (c), rebuilt local-first).
+
+Usage inside training code (auto-attaches to the active run via the env vars
+the executor/converter inject):
+
+    from polyaxon_tpu import tracking
+    run = tracking.init()           # or tracking.init(name=..., project=...)
+    run.log_metrics(loss=0.3, step=10)
+    run.log_artifact("/path/to/file")
+    run.end()
+
+Events go straight to the run's directory in the local store — the same
+files the streams service serves — so there is no sidecar hop in the local
+path; on a cluster the store home points at the mounted artifact volume and
+the flow is identical.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid as _uuid
+from pathlib import Path
+from typing import Any, Optional
+
+from ..schemas.lifecycle import V1Statuses
+from ..store.local import RunStore
+
+_active_run: Optional["Run"] = None
+
+
+class Run:
+    def __init__(
+        self,
+        run_uuid: Optional[str] = None,
+        *,
+        name: Optional[str] = None,
+        project: Optional[str] = None,
+        store: Optional[RunStore] = None,
+        is_new: bool = False,
+    ):
+        self.store = store or RunStore()
+        self.uuid = run_uuid or os.environ.get("POLYAXON_RUN_UUID")
+        self._owns_lifecycle = False
+        if self.uuid is None:
+            # standalone script without an orchestrated run: create one
+            self.uuid = _uuid.uuid4().hex
+            self.store.create_run(
+                self.uuid,
+                name or f"tracked-{self.uuid[:8]}",
+                project or os.environ.get("POLYAXON_PROJECT", "default"),
+                spec={"kind": "tracked"},
+            )
+            self.store.set_status(self.uuid, V1Statuses.COMPILED)
+            self.store.set_status(self.uuid, V1Statuses.QUEUED)
+            self.store.set_status(self.uuid, V1Statuses.SCHEDULED)
+            self.store.set_status(self.uuid, V1Statuses.RUNNING)
+            self._owns_lifecycle = True
+        elif is_new:
+            self._owns_lifecycle = True
+        self._step = 0
+
+    # ------------------------------------------------------------- logging
+    def log_metrics(self, step: Optional[int] = None, **metrics: float):
+        if step is None:
+            step = self._step
+            self._step += 1
+        else:
+            self._step = step + 1
+        self.store.log_metrics(self.uuid, step, {k: float(v) for k, v in metrics.items()})
+
+    def log_metric(self, name: str, value: float, step: Optional[int] = None):
+        self.log_metrics(step=step, **{name: value})
+
+    def log_outputs(self, **outputs: Any):
+        self.store.log_event(self.uuid, "outputs", {"outputs": outputs})
+
+    def log_tags(self, *tags: str):
+        self.store.log_event(self.uuid, "tags", {"tags": list(tags)})
+
+    def log_artifact(self, path: str, name: Optional[str] = None, kind: str = "file"):
+        """Copy a file into the run's outputs dir and record a lineage event."""
+        src = Path(path)
+        dst = self.outputs_path / (name or src.name)
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        if src.resolve() != dst.resolve():
+            shutil.copy2(src, dst)
+        self.store.log_event(
+            self.uuid, "artifact", {"name": name or src.name, "path": str(dst), "artifact_kind": kind}
+        )
+        return str(dst)
+
+    def log_text(self, text: str):
+        self.store.append_log(self.uuid, text)
+
+    # ------------------------------------------------------------- info
+    @property
+    def outputs_path(self) -> Path:
+        env = os.environ.get("POLYAXON_RUN_OUTPUTS_PATH")
+        return Path(env) if env else self.store.outputs_dir(self.uuid)
+
+    def get_metrics(self) -> list[dict]:
+        return self.store.read_metrics(self.uuid)
+
+    def get_status(self) -> str:
+        return self.store.get_status(self.uuid).get("status", "unknown")
+
+    def refresh_data(self) -> dict:
+        return self.store.get_status(self.uuid)
+
+    # ------------------------------------------------------------- lifecycle
+    def end(self, status: str = V1Statuses.SUCCEEDED):
+        global _active_run
+        if self._owns_lifecycle:
+            self.store.set_status(self.uuid, status)
+        if _active_run is self:
+            _active_run = None
+
+
+def init(**kwargs) -> Run:
+    """Create/attach the process-global tracked run."""
+    global _active_run
+    if _active_run is None:
+        _active_run = Run(is_new=False, **kwargs)
+    return _active_run
+
+
+def get_or_create_run() -> Run:
+    return init()
+
+
+def log_metrics(step: Optional[int] = None, **metrics):
+    init().log_metrics(step=step, **metrics)
+
+
+def end(status: str = V1Statuses.SUCCEEDED):
+    if _active_run is not None:
+        _active_run.end(status)

@@ -1,18 +1,24 @@
 """Test harness: fake an 8-device TPU slice on CPU so sharding/collective
 tests run without hardware (SURVEY.md §4: the reference tests multi-node by
-golden-rendering specs; we additionally execute on a virtual mesh)."""
+golden-rendering specs; we additionally execute on a virtual mesh).
 
-import os
+The axon TPU-tunnel plugin pre-sets JAX_PLATFORMS=axon and wins over env
+vars, so platform selection must go through jax.config before backends
+initialize — conftest import time is early enough.
+"""
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
 
-import pytest
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import os  # noqa: E402
+
+# children spawned by tests (multi-process distributed harness) inherit these
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+import pytest  # noqa: E402
 
 
 @pytest.fixture()
