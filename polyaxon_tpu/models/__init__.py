@@ -6,4 +6,8 @@ from .registry import (  # noqa: F401
     register,
     registered_models,
 )
+from . import bert  # noqa: F401
 from . import mlp  # noqa: F401
+from . import resnet  # noqa: F401
+from . import transformer  # noqa: F401
+from . import vit  # noqa: F401

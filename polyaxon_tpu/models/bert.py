@@ -1,0 +1,93 @@
+"""BERT encoder for MLM pretraining — BASELINE config #3.
+
+Post-LN encoder (models/encoder.py) with learned positions and a weight-tied
+MLM head (transform + embedding-transpose decode), trained on the
+`synthetic_mlm` stream with the `masked_lm` loss."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .encoder import ENCODER_RULES, EncoderBlock
+from .registry import ModelBundle, i32_tokens, register
+
+PRESETS = {
+    "tiny-test": dict(dim=128, n_layers=2, n_heads=4, seq_len=64, vocab_size=1024),
+    "bert-base": dict(dim=768, n_layers=12, n_heads=12, seq_len=512, vocab_size=30522),
+    "bert-large": dict(dim=1024, n_layers=24, n_heads=16, seq_len=512, vocab_size=30522),
+}
+
+
+class Bert(nn.Module):
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    seq_len: int = 512
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    attention: str = "xla"
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        embed = nn.Embed(
+            self.vocab_size,
+            self.dim,
+            name="embed",
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        x = embed(tokens)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, self.seq_len, self.dim)
+        )
+        x = x + pos[:, : tokens.shape[1]]
+        x = nn.LayerNorm(name="embed_norm")(x)
+        for i in range(self.n_layers):
+            x = EncoderBlock(
+                self.dim,
+                self.n_heads,
+                self.dim * self.mlp_ratio,
+                self.dropout_rate,
+                pre_norm=False,
+                backend=self.attention,
+                name=f"block_{i}",
+            )(x, train=train)
+        # MLM head: transform, then decode against tied embeddings
+        x = nn.Dense(self.dim, name="mlm_transform")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(name="mlm_norm")(x)
+        logits = embed.attend(x.astype(jnp.float32))
+        bias = self.param("mlm_bias", nn.initializers.zeros, (self.vocab_size,))
+        return logits + bias
+
+
+@register("bert")
+def build_bert(config: dict) -> ModelBundle:
+    preset = config.pop("preset", None)
+    if preset is not None and preset not in PRESETS:
+        raise ValueError(f"unknown BERT preset {preset!r}; known: {sorted(PRESETS)}")
+    base = dict(PRESETS.get(preset, PRESETS["bert-base"]))
+    base.update(config)
+    module = Bert(
+        vocab_size=int(base.get("vocab_size", 30522)),
+        dim=int(base.get("dim", 768)),
+        n_layers=int(base.get("n_layers", 12)),
+        n_heads=int(base.get("n_heads", 12)),
+        seq_len=int(base.get("seq_len", 512)),
+        mlp_ratio=int(base.get("mlp_ratio", 4)),
+        dropout_rate=float(base.get("dropout_rate", 0.0)),
+        attention=str(base.get("attention", "xla")),
+    )
+    return ModelBundle(
+        name="bert",
+        module=module,
+        example_inputs=i32_tokens(module.seq_len),
+        loss="masked_lm",
+        task="mlm",
+        sharding_rules=ENCODER_RULES
+        + (
+            (r"embed/embedding", ("model", "fsdp")),
+            (r"mlm_transform/kernel", ("fsdp", "model")),
+        ),
+    )

@@ -1,0 +1,81 @@
+"""Bidirectional transformer encoder blocks shared by ViT and BERT.
+
+Projection names match the decoder's (q/k/v/o_proj) so one set of Megatron
+TP sharding rules covers every transformer in the zoo (see ENCODER_RULES).
+Pre-LN (ViT) vs post-LN (BERT) is a flag; attention is full (no causal
+mask), logits accumulated in f32.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class MultiHeadAttention(nn.Module):
+    """Bidirectional MHA through the shared backend dispatch
+    (ops/attention.py) — xla/flash/ring all work with causal=False.
+    Residual-path dropout lives in EncoderBlock; attention-prob dropout is
+    intentionally absent (unsupported by the blockwise backends)."""
+
+    dim: int
+    n_heads: int
+    backend: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        from ..ops.attention import dot_product_attention
+
+        B, S, _ = x.shape
+        hd = self.dim // self.n_heads
+        q = nn.Dense(self.dim, name="q_proj")(x).reshape(B, S, self.n_heads, hd)
+        k = nn.Dense(self.dim, name="k_proj")(x).reshape(B, S, self.n_heads, hd)
+        v = nn.Dense(self.dim, name="v_proj")(x).reshape(B, S, self.n_heads, hd)
+        out = dot_product_attention(q, k, v, causal=False, backend=self.backend)
+        out = out.reshape(B, S, self.dim)
+        return nn.Dense(self.dim, name="o_proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    dim: int
+    n_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    pre_norm: bool = True  # ViT pre-LN; BERT post-LN
+    eps: float = 1e-6
+    backend: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        attn = MultiHeadAttention(
+            self.dim, self.n_heads, self.backend, name="attention"
+        )
+        drop = (
+            (lambda h: nn.Dropout(self.dropout_rate, deterministic=not train)(h))
+            if self.dropout_rate
+            else (lambda h: h)
+        )
+
+        def mlp(h):
+            h = nn.Dense(self.mlp_dim, name="fc1")(h)
+            h = nn.gelu(h)
+            return nn.Dense(self.dim, name="fc2")(h)
+
+        ln1 = nn.LayerNorm(epsilon=self.eps, name="norm1")
+        ln2 = nn.LayerNorm(epsilon=self.eps, name="norm2")
+        if self.pre_norm:
+            x = x + drop(attn(ln1(x), train=train))
+            x = x + drop(mlp(ln2(x)))
+        else:
+            x = ln1(x + drop(attn(x, train=train)))
+            x = ln2(x + drop(mlp(x)))
+        return x
+
+
+# One TP/FSDP rule set for all encoder stacks (paths are unanchored; each
+# model adds its own embedding/head rules).
+ENCODER_RULES = (
+    (r"(q_proj|k_proj|v_proj)/kernel", ("fsdp", "model")),
+    (r"o_proj/kernel", ("model", "fsdp")),
+    (r"fc1/kernel", ("fsdp", "model")),
+    (r"fc2/kernel", ("model", "fsdp")),
+)

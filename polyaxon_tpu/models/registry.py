@@ -41,6 +41,13 @@ class ModelBundle:
     sharding_rules: tuple = ()
     task: str = "classification"
     rngs: tuple[str, ...] = ("dropout",)
+    # If non-empty: only params whose path matches one of these regexes are
+    # trained; the rest are frozen — the trainer wraps the optimizer in
+    # optax.multi_transform with set_to_zero() for non-matching params
+    # (NOT optax.masked, which would pass raw grads through as updates).
+    trainable_patterns: tuple = ()
+    # Extra collections the module carries through apply (e.g. batch_stats).
+    mutable: tuple[str, ...] = ()
 
 
 def register(name: str):

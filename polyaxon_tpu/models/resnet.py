@@ -1,0 +1,134 @@
+"""ResNet-v1.5 — BASELINE config #2 (ResNet-50 data-parallel throughput).
+
+Bottleneck residual stacks; BatchNorm runs in f32 with running stats in the
+`batch_stats` collection (the trainer threads it through TrainState.extra).
+Convs stay NHWC — XLA's preferred TPU conv layout."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import ModelBundle, f32_images, register
+
+STAGE_SIZES = {
+     18: (2, 2, 2, 2),
+     34: (3, 4, 6, 3),
+     50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+BOTTLENECK = {50, 101, 152}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=jnp.float32
+        )
+        conv = partial(nn.Conv, use_bias=False)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides,) * 2, name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), strides=(self.strides,) * 2, name="proj"
+            )(x)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=jnp.float32
+        )
+        conv = partial(nn.Conv, use_bias=False)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides,) * 2, name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters, (1, 1), strides=(self.strides,) * 2, name="proj"
+            )(x)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        block_cls = BottleneckBlock if self.depth in BOTTLENECK else BasicBlock
+        x = nn.Conv(
+            self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, name="stem_conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, dtype=jnp.float32,
+            name="stem_bn",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(STAGE_SIZES[self.depth]):
+            for b in range(n_blocks):
+                x = block_cls(
+                    self.width * (2**stage),
+                    strides=2 if stage > 0 and b == 0 else 1,
+                    name=f"stage{stage + 1}_block{b}",
+                )(x, train=train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+@register("resnet")
+def build_resnet(config: dict) -> ModelBundle:
+    depth = int(config.get("depth", 50))
+    if depth not in STAGE_SIZES:
+        raise ValueError(f"resnet depth {depth} not in {sorted(STAGE_SIZES)}")
+    module = ResNet(
+        depth=depth,
+        num_classes=int(config.get("num_classes", 1000)),
+        width=int(config.get("width", 64)),
+    )
+    size = int(config.get("image_size", 224))
+    return ModelBundle(
+        name="resnet",
+        module=module,
+        example_inputs=f32_images((size, size, 3)),
+        # DP is the throughput recipe for ResNet; the only TP-worthy kernel
+        # is the head. fsdp shards the big 3x3 conv output channels.
+        sharding_rules=(
+            (r"conv2/kernel", (None, None, None, "fsdp")),
+            (r"head/kernel", ("fsdp", "model")),
+        ),
+        rngs=(),
+        mutable=("batch_stats",),
+    )
+
+
+@register("resnet50")
+def build_resnet50(config: dict) -> ModelBundle:
+    config = dict(config, depth=50)
+    bundle = build_resnet(config)
+    return bundle

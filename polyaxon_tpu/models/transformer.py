@@ -1,0 +1,295 @@
+"""Decoder-only transformer (Llama-family) — the flagship JAXJob model.
+
+Reference parity: BASELINE config #5 (Llama-3-8B LoRA multi-host) — the
+reference orchestrated this in user containers (SURVEY.md §1); here the
+model is in-repo and TPU-shaped:
+
+- RMSNorm + RoPE + grouped-query attention + SwiGLU (Llama architecture),
+  all expressed as large batched matmuls/einsums the MXU tiles natively.
+- Megatron-style tensor-parallel sharding rules: QKV/gate/up kernels split
+  output-dim over the `model` axis, o/down kernels split input-dim — one
+  all-reduce per block, inserted by XLA from the shardings.
+- `fsdp` axis shards the complementary kernel dim (ZeRO-3 style); rules
+  degrade to replication on meshes without those axes (parallel/sharding.py).
+- `scan_layers`: stack the blocks with `nn.scan` so compile time is O(1) in
+  depth (XLA sees one block body; params gain a leading layer axis).
+- Attention backend selectable: `xla` (einsum softmax, fine for short seq),
+  `flash` (Pallas blockwise kernel, ops/flash_attention.py), `ring`
+  (context-parallel blockwise over the `context` axis, parallel/ring.py).
+- Optional LoRA (`lora_rank > 0`): frozen base kernels + trainable A/B
+  adapters on all projections; the trainer masks the optimizer to adapter
+  params via `ModelBundle.trainable_patterns`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ModelBundle, i32_tokens, register
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    hidden_dim: Optional[int] = None  # default 8/3 * dim rounded up to 128
+    seq_len: int = 512
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dropout_rate: float = 0.0
+    attention: str = "xla"  # xla | flash | ring
+    attention_block: int = 512  # kv block size for flash/ring backends
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    tie_embeddings: bool = False
+    scan_layers: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.hidden_dim:
+            return self.hidden_dim
+        h = int(8 * self.dim / 3)
+        return ((h + 127) // 128) * 128  # MXU-friendly multiple of 128
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float):
+    """Precomputed cos/sin [seq, head_dim/2] — static numpy, so they enter
+    the jaxpr as constants shared across layers (scan broadcasts them)."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    ang = np.outer(np.arange(seq_len, dtype=np.float32), freqs)
+    return np.cos(ang), np.sin(ang)
+
+
+def apply_rope(x: jnp.ndarray, cos, sin, offset: int = 0):
+    """x: [B, S, H, D]. Rotates the (first-half, second-half) pairs."""
+    seq = x.shape[1]
+    c = jax.lax.dynamic_slice_in_dim(cos, offset, seq)[None, :, None, :]
+    s = jax.lax.dynamic_slice_in_dim(sin, offset, seq)[None, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+class LoRADense(nn.Module):
+    """Dense whose base kernel is frozen (optimizer-masked) with a trainable
+    low-rank delta: y = x W + (alpha/r)(x A)B. Param names carry `lora_` so
+    the bundle's trainable_patterns select them."""
+
+    features: int
+    rank: int
+    alpha: float
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (in_dim, self.features)
+        )
+        a = self.param("lora_a", nn.initializers.normal(1e-2), (in_dim, self.rank))
+        b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features))
+        y = x @ kernel.astype(x.dtype)
+        delta = (x @ a.astype(x.dtype)) @ b.astype(x.dtype)
+        return y + (self.alpha / self.rank) * delta
+
+
+def _proj(cfg: TransformerConfig, features: int, name: str):
+    if cfg.lora_rank > 0:
+        return LoRADense(features, rank=cfg.lora_rank, alpha=cfg.lora_alpha, name=name)
+    return nn.Dense(features, use_bias=False, name=name)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        q = _proj(cfg, nh * hd, "q_proj")(x).reshape(B, S, nh, hd)
+        k = _proj(cfg, nkv * hd, "k_proj")(x).reshape(B, S, nkv, hd)
+        v = _proj(cfg, nkv * hd, "v_proj")(x).reshape(B, S, nkv, hd)
+        cos_np, sin_np = rope_table(cfg.seq_len, hd, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if nkv != nh:  # GQA: expand kv heads to query-head count
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        from ..ops.attention import dot_product_attention
+
+        out = dot_product_attention(
+            q, k, v, causal=True, backend=cfg.attention,
+            block_kv=cfg.attention_block,
+        )
+        out = out.reshape(B, S, nh * hd)
+        return _proj(cfg, cfg.dim, "o_proj")(out)
+
+
+class FeedForward(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _proj(cfg, cfg.ffn_dim, "gate_proj")(x)
+        up = _proj(cfg, cfg.ffn_dim, "up_proj")(x)
+        return _proj(cfg, cfg.dim, "down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = Attention(cfg, name="attention")(
+            RMSNorm(cfg.norm_eps, name="attention_norm")(x), train=self.train
+        )
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
+        x = x + h
+        h = FeedForward(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
+        return x + h
+
+
+class _ScanBlock(nn.Module):
+    """Scan body: (carry, _) → (carry, None) signature nn.scan requires."""
+
+    cfg: TransformerConfig
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x, _):
+        return Block(self.cfg, self.train, name="block")(x), None
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.dim,
+            name="embed",
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        x = embed(tokens)
+        if cfg.scan_layers:
+            Layers = nn.scan(
+                _ScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+            )
+            x, _ = Layers(cfg, train, name="layers")(x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = Block(cfg, train, name=f"layer_{i}")(x)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            return embed.attend(x.astype(jnp.float32))
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(x)
+
+
+# -------------------------------------------------------------- sharding rules
+# Megatron TP: column-parallel (out-dim on `model`) for q/k/v/gate/up, row-
+# parallel (in-dim on `model`) for o/down; fsdp shards the complementary dim.
+# Patterns are unanchored so they match both `layer_3/...` and the scan
+# layout `layers/block/...` (where kernels gain a leading layer axis — the
+# rule axes then apply to the trailing dims via the sharding resolver).
+TRANSFORMER_RULES = (
+    (r"embed/embedding", ("model", "fsdp")),
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel", ("fsdp", "model")),
+    (r"(o_proj|down_proj)/kernel", ("model", "fsdp")),
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_a", ("fsdp", None)),
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_b", (None, "model")),
+    (r"(o_proj|down_proj)/lora_a", ("model", None)),
+    (r"(o_proj|down_proj)/lora_b", (None, "fsdp")),
+    (r"lm_head/kernel", ("fsdp", "model")),
+)
+
+# Under nn.scan, kernels are [layers, in, out]: shift rules right by one dim.
+SCAN_RULES = tuple(
+    (pat, (None, *axes)) if "embedding" not in pat and "lm_head" not in pat else (pat, axes)
+    for pat, axes in TRANSFORMER_RULES
+)
+
+PRESETS: dict[str, dict] = {
+    # tiny flagship used by tests / graft entry / bench
+    "tiny": dict(
+        dim=256, n_layers=4, n_heads=8, n_kv_heads=4, vocab_size=4096, seq_len=256
+    ),
+    "llama3-8b": dict(
+        dim=4096, n_layers=32, n_heads=32, n_kv_heads=8, hidden_dim=14336,
+        vocab_size=128256, seq_len=8192, rope_theta=500000.0,
+    ),
+    "llama3-1b": dict(
+        dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, hidden_dim=8192,
+        vocab_size=128256, seq_len=8192, rope_theta=500000.0,
+    ),
+}
+
+
+def _make_config(config: dict) -> TransformerConfig:
+    preset = config.pop("preset", None)
+    if preset is not None and preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; known: {sorted(PRESETS)}")
+    base: dict = dict(PRESETS.get(preset, {}))
+    base.update({k: v for k, v in config.items() if v is not None})
+    fields = {f.name for f in dataclasses.fields(TransformerConfig)}
+    return TransformerConfig(**{k: v for k, v in base.items() if k in fields})
+
+
+@register("transformer_lm")
+def build_transformer(config: dict) -> ModelBundle:
+    cfg = _make_config(config)
+    module = Transformer(cfg)
+    trainable = (r"lora_[ab]$",) if cfg.lora_rank > 0 else ()
+    return ModelBundle(
+        name="transformer_lm",
+        module=module,
+        example_inputs=i32_tokens(cfg.seq_len),
+        loss="masked_lm",
+        sharding_rules=SCAN_RULES if cfg.scan_layers else TRANSFORMER_RULES,
+        task="lm",
+        trainable_patterns=trainable,
+    )
+
+
+@register("llama")
+def build_llama(config: dict) -> ModelBundle:
+    config.setdefault("preset", "llama3-8b")
+    bundle = build_transformer(config)
+    return dataclasses.replace(bundle, name="llama")

@@ -1,0 +1,88 @@
+"""Vision Transformer — BASELINE config #4 (ViT-S/16 Hyperband sweep).
+
+Patchify = one strided conv (an MXU matmul after im2col, XLA does this
+natively); encoder blocks from models/encoder.py; mean-pool head (simpler
+than a cls token and equivalent at this scale)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .encoder import ENCODER_RULES, EncoderBlock
+from .registry import ModelBundle, f32_images, register
+
+PRESETS = {
+    "tiny-test": dict(dim=128, n_layers=2, n_heads=4, patch=8, image_size=32),
+    "vit-s16": dict(dim=384, n_layers=12, n_heads=6, patch=16, image_size=224),
+    "vit-b16": dict(dim=768, n_layers=12, n_heads=12, patch=16, image_size=224),
+}
+
+
+class ViT(nn.Module):
+    dim: int = 384
+    n_layers: int = 12
+    n_heads: int = 6
+    patch: int = 16
+    image_size: int = 224
+    num_classes: int = 1000
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    attention: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        p = self.patch
+        x = nn.Conv(
+            self.dim, (p, p), strides=(p, p), padding="VALID", name="patch_embed"
+        )(x)
+        B, H, W, C = x.shape
+        x = x.reshape(B, H * W, C)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, H * W, self.dim)
+        )
+        x = x + pos
+        for i in range(self.n_layers):
+            x = EncoderBlock(
+                self.dim,
+                self.n_heads,
+                self.dim * self.mlp_ratio,
+                self.dropout_rate,
+                pre_norm=True,
+                backend=self.attention,
+                name=f"block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(name="final_norm")(x)
+        x = x.mean(axis=1)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+@register("vit")
+def build_vit(config: dict) -> ModelBundle:
+    preset = config.pop("preset", None)
+    if preset is not None and preset not in PRESETS:
+        raise ValueError(f"unknown ViT preset {preset!r}; known: {sorted(PRESETS)}")
+    base = dict(PRESETS.get(preset, PRESETS["vit-s16"]))
+    base.update(config)
+    module = ViT(
+        dim=int(base.get("dim", 384)),
+        n_layers=int(base.get("n_layers", 12)),
+        n_heads=int(base.get("n_heads", 6)),
+        patch=int(base.get("patch", 16)),
+        image_size=int(base.get("image_size", 224)),
+        num_classes=int(base.get("num_classes", 1000)),
+        mlp_ratio=int(base.get("mlp_ratio", 4)),
+        dropout_rate=float(base.get("dropout_rate", 0.0)),
+        attention=str(base.get("attention", "xla")),
+    )
+    size = module.image_size
+    return ModelBundle(
+        name="vit",
+        module=module,
+        example_inputs=f32_images((size, size, 3)),
+        sharding_rules=ENCODER_RULES
+        + (
+            (r"patch_embed/kernel", (None, None, None, "model")),
+            (r"head/kernel", ("fsdp", None)),
+        ),
+    )

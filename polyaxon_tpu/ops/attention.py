@@ -1,0 +1,42 @@
+"""Shared scaled-dot-product attention dispatch for every model in the zoo.
+
+One implementation, three backends:
+  xla   — einsum + softmax; scores accumulated in f32 via
+          preferred_element_type (a bf16 MXU dot would round the scores
+          before any later cast could help).
+  flash — Pallas blockwise kernel (ops/flash_attention.py), O(S) memory.
+  ring  — context-parallel blockwise over the mesh `context` axis
+          (parallel/ring.py); falls back to flash off-mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dot_product_attention(
+    q, k, v, *, causal: bool, backend: str = "xla", block_kv: int = 512
+):
+    """q/k/v: [B, S, H, D], equal head counts (expand GQA first) → [B, S, H, D]."""
+    if backend == "flash":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+    if backend == "ring":
+        from ..parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, block_kv=block_kv, causal=causal)
+    if backend != "xla":
+        raise ValueError(f"unknown attention backend {backend!r}")
+    hd = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(hd)
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,310 @@
+"""Blockwise (flash) attention as Pallas TPU kernels, fwd + bwd.
+
+The hot op of every transformer in the zoo. Design (pallas_guide.md):
+- Online softmax over KV blocks: running max/denominator in VMEM scratch,
+  O(S) memory instead of the O(S^2) score matrix.
+- Grid (batch*heads, q-blocks, kv-blocks) — the innermost grid dim runs
+  sequentially on a TPU core, so scratch accumulators carry across KV
+  blocks; output is written on the last KV step.
+- Causal runs skip fully-masked blocks via pl.when (half the FLOPs).
+- Scores/accumulators in f32 (bf16 softmax loses probability mass); the
+  two matmuls per block hit the MXU via preferred_element_type.
+- Backward = two kernels: dq over (q-block, kv-steps), dk/dv over
+  (kv-block, q-steps), each recomputing p from the saved logsumexp —
+  the standard FlashAttention-2 recipe.
+- Off-TPU (CPU tests) the same kernels run with interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+_LANES = 128  # f32 scratch lane width
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_kv,
+):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    live = (
+        ik * block_kv <= iq * block_q + block_q - 1 if causal else ik >= 0
+    )
+
+    @pl.when(live)
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bkv]
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            cols = ik * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, :1] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_kv):
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+    nq, nk = S // block_q, S // block_kv
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ------------------------------------------------------------------ backward
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, block_q, block_kv,
+):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (
+        ik * block_kv <= iq * block_q + block_q - 1 if causal else ik >= 0
+    )
+
+    @pl.when(live)
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            cols = ik * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_kv,
+):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (
+        iq * block_q + block_q - 1 >= ik * block_kv if causal else iq >= 0
+    )
+
+    @pl.when(live)
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            cols = ik * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [bq, bkv]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------ custom vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_kv):
+    o, _ = _fwd(q, k, v, causal, scale, block_q, block_kv)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_kv)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, res, do):
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, o, lse = res
+    BH, S, D = q.shape
+    nq, nk = S // block_q, S // block_kv
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    common = dict(scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, D), jnp.float32),
+            pltpu.VMEM((block_kv, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------------------------ public api
+def flash_attention(
+    q, k, v, *, causal=True, block_q=128, block_kv=128, sm_scale=None
+):
+    """q/k/v: [B, S, H, D] (same head count — expand GQA before calling).
+    Returns [B, S, H, D]."""
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_kv = min(block_kv, S)
+    if S % block_q or S % block_kv:
+        raise ValueError(f"seq len {S} not divisible by blocks {block_q}/{block_kv}")
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+
+    def to_bh(x):  # [B,S,H,D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, scale, block_q, block_kv)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

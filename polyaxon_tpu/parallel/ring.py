@@ -1,0 +1,120 @@
+"""Ring attention: context-parallel causal attention over the `context` axis.
+
+The long-context strategy the reference never had in-repo (SURVEY.md §5:
+sequence parallelism was user-code's problem). Design:
+
+- The trainer shards the sequence dim of token batches over the mesh's
+  `context` axis; inside the model, `ring_attention` drops into `shard_map`
+  so each device holds one sequence chunk of Q/K/V.
+- N-1 `ppermute` hops rotate KV chunks around the ring (nearest-neighbor
+  ICI traffic only); each hop's block attention is merged with the online-
+  softmax rule, so memory stays O(S_local^2) per step and the full S^2
+  score matrix never materializes anywhere.
+- Causality by chunk provenance: a KV chunk from an earlier rank attends
+  fully, the own chunk attends lower-triangular, later ranks are skipped
+  (masked to zero weight — static shapes, XLA-friendly).
+- Pure jnp + ppermute, so autodiff produces the reverse-ring backward for
+  free; the unrolled Python loop lets XLA overlap each hop's collective
+  with the previous hop's compute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from .mesh import BATCH_AXES
+
+NEG_INF = -1e30
+
+# Mesh currently in scope for model-internal collectives (ring attention,
+# MoE all-to-all). The trainer sets this before tracing; a context var
+# rather than a module argument keeps model code mesh-agnostic.
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH
+
+
+def _chunk_attention(q, k, v, scale, full, same):
+    """One KV chunk's contribution: returns (o_unnorm, m, l).
+
+    full/same are scalar bools (chunk provenance); masked-out entries get
+    probability 0 via the `allowed` mask, never a -inf softmax (avoids the
+    all-masked NaN)."""
+    S_q, S_k = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    tril = jnp.tril(jnp.ones((S_q, S_k), bool))
+    allowed = full | (same & tril[None, None])
+    s = jnp.where(allowed, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.where(allowed, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def _ring_body(q, k, v, axis_name: str, n: int, scale: float, causal: bool):
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    o = jnp.zeros((B, S, H, D), jnp.float32)
+    m = jnp.full((B, H, S, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, S, 1), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for t in range(n):
+        src = (idx - t) % n
+        if causal:
+            full, same = src < idx, src == idx
+        else:
+            full, same = jnp.bool_(True), jnp.bool_(False)
+        o_i, m_i, l_i = _chunk_attention(q, k, v, scale, full=full, same=same)
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)  # rescale of the running accumulator
+        beta = jnp.exp(m_i - m_new)  # rescale of this chunk
+        l = alpha * l + beta * l_i
+        o = o * alpha.transpose(0, 2, 1, 3) + o_i * beta.transpose(0, 2, 1, 3)
+        m = m_new
+        if t != n - 1:  # rotate KV to the next rank; last hop needs no send
+            k, v = jax.lax.ppermute((k, v), axis_name, perm)
+    return (o / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v, *, axis_name: str = "context", block_kv: int = 512, causal: bool = True
+):
+    """Attention with Q/K/V sequence-sharded over `axis_name`.
+
+    q/k/v: [B, S, H, D] global shapes (same head count — expand GQA first).
+    Falls back to single-device flash attention when the mesh has no
+    (non-trivial) context axis, so models can use `attention: ring`
+    unconditionally."""
+    mesh = current_mesh()
+    n = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
+    scale = q.shape[-1] ** -0.5
+    if n <= 1:
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+
+    batch = tuple(ax for ax in BATCH_AXES if mesh.shape.get(ax, 1) > 1) or None
+    head = "model" if mesh.shape.get("model", 1) > 1 else None
+    spec = P(batch, axis_name, head, None)
+    inner = shard_map(
+        partial(_ring_body, axis_name=axis_name, n=n, scale=scale, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return inner(q, k, v)

@@ -46,6 +46,9 @@ class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    # non-trained variable collections (e.g. BatchNorm batch_stats), keyed by
+    # collection name; empty dict when the model declares none
+    extra: Any = struct.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -117,6 +120,11 @@ class Trainer:
         )
         self.loss_fn = build_loss(tspec.loss or self.bundle.loss)
         self.mesh = build_mesh(mesh_axes, devices=devices)
+        # model-internal collectives (ring attention, MoE all-to-all) read
+        # the mesh from this context var at trace time
+        from ..parallel.ring import set_current_mesh
+
+        set_current_mesh(self.mesh)
         self.compute_dtype = _compute_dtype(tspec.precision)
         self.param_dtype = (
             jnp.bfloat16 if tspec.precision == "bfloat16" else jnp.float32
@@ -135,6 +143,8 @@ class Trainer:
         example = bundle.example_inputs(global_batch)
         init_rng = jax.random.PRNGKey(int(tspec.seed))
 
+        mutable = tuple(bundle.mutable)
+
         def init_fn(rng):
             variables = bundle.module.init(
                 {"params": rng, **{k: rng for k in bundle.rngs}},
@@ -144,19 +154,51 @@ class Trainer:
             params = variables["params"]
             if self.param_dtype != jnp.float32:
                 params = _cast_floats(params, self.param_dtype)
-            return params
+            extra = {k: variables[k] for k in mutable}
+            return params, extra
 
-        abstract_params = jax.eval_shape(init_fn, init_rng)
+        abstract_params, abstract_extra = jax.eval_shape(init_fn, init_rng)
+        if bundle.trainable_patterns:
+            # LoRA-style fine-tune: non-matching params get zero updates.
+            # multi_transform (not optax.masked — masked passes raw grads
+            # through as updates for the frozen side).
+            import re as _re
+
+            from ..parallel.sharding import _path_str
+
+            pats = tuple(_re.compile(p) for p in bundle.trainable_patterns)
+            labels = jax.tree_util.tree_map_with_path(
+                lambda path, _: "train"
+                if any(p.search(_path_str(path)) for p in pats)
+                else "freeze",
+                abstract_params,
+            )
+            self.tx = optax.multi_transform(
+                {"train": self.tx, "freeze": optax.set_to_zero()}, labels
+            )
         self.p_shard = param_shardings(abstract_params, bundle.sharding_rules, mesh)
+        e_shard = param_shardings(abstract_extra, bundle.sharding_rules, mesh)
         o_shard = _opt_state_shardings(self.tx, abstract_params, self.p_shard, mesh)
-        params = jax.jit(init_fn, out_shardings=self.p_shard)(init_rng)
+        params, extra = jax.jit(init_fn, out_shardings=(self.p_shard, e_shard))(
+            init_rng
+        )
         opt_state = jax.jit(self.tx.init, out_shardings=o_shard)(params)
         self.state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            extra=extra,
         )
-        self.b_shard = batch_sharding(mesh)
+        # token batches [B, S] shard the sequence dim over `context` so ring
+        # attention's shard_map receives already-placed chunks
+        extra_axes = None
+        if bundle.task in ("lm", "mlm") and mesh.shape.get("context", 1) > 1:
+            extra_axes = {"1": "context"}
+        self.b_shard = batch_sharding(mesh, extra_axes)
         rep = replicated(mesh)
-        state_shardings = TrainState(step=rep, params=self.p_shard, opt_state=o_shard)
+        state_shardings = TrainState(
+            step=rep, params=self.p_shard, opt_state=o_shard, extra=e_shard
+        )
 
         compute_dtype = self.compute_dtype
         loss_fn, tx, sched = self.loss_fn, self.tx, self.sched
@@ -164,11 +206,14 @@ class Trainer:
         is_classification = bundle.task == "classification"
         seed = int(tspec.seed)
 
-        def apply(params, inputs, rng):
+        def apply(params, extra, inputs, rng):
             rngs = {k: jax.random.fold_in(rng, i) for i, k in enumerate(bundle.rngs)}
-            return bundle.module.apply(
-                {"params": params}, inputs, train=True, rngs=rngs
-            )
+            variables = {"params": params, **extra}
+            if mutable:
+                return bundle.module.apply(
+                    variables, inputs, train=True, rngs=rngs, mutable=list(mutable)
+                )
+            return bundle.module.apply(variables, inputs, train=True, rngs=rngs), {}
 
         if use_remat:
             apply = jax.checkpoint(apply)
@@ -187,12 +232,12 @@ class Trainer:
                 inputs = batch["inputs"]
                 if jnp.issubdtype(inputs.dtype, jnp.floating):
                     inputs = inputs.astype(compute_dtype)
-                logits = apply(compute_params, inputs, rng)
-                return loss_fn(logits, batch), logits
+                logits, new_extra = apply(compute_params, state.extra, inputs, rng)
+                return loss_fn(logits, batch), (logits, new_extra)
 
-            (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                state.params
-            )
+            (loss, (logits, new_extra)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(state.params)
             # grads come out in compute dtype; update math runs in param dtype
             grads = _cast_floats(grads, param_dtype)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -205,7 +250,12 @@ class Trainer:
             if is_classification:
                 metrics["accuracy"] = accuracy_metric(logits, batch)
             return (
-                TrainState(step=state.step + 1, params=params, opt_state=opt_state),
+                TrainState(
+                    step=state.step + 1,
+                    params=params,
+                    opt_state=opt_state,
+                    extra=new_extra,
+                ),
                 metrics,
             )
 
@@ -219,6 +269,9 @@ class Trainer:
 
     # -------------------------------------------------------------- loop
     def run(self) -> TrainResult:
+        from ..parallel.ring import set_current_mesh
+
+        set_current_mesh(self.mesh)  # re-bind: another Trainer may have traced
         tspec = self.tspec
         log_every = max(1, int(tspec.log_every))
         ckpt_every = int(tspec.checkpoint_every) if tspec.checkpoint_every else 0

@@ -1,0 +1,119 @@
+"""Flash-attention kernel and ring-attention correctness vs the XLA
+reference implementation, forward and backward (pallas kernels run
+interpreted on the CPU test mesh; the same code compiles on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.ops.attention import dot_product_attention
+from polyaxon_tpu.ops.flash_attention import flash_attention
+from polyaxon_tpu.parallel.mesh import build_mesh
+from polyaxon_tpu.parallel.ring import ring_attention, set_current_mesh
+
+
+def _qkv(B=2, S=128, H=4, D=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, S, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_xla_forward(causal):
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=causal, backend="xla")
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_kv=64)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_xla_backward(causal):
+    q, k, v = _qkv(S=64)
+
+    def loss_flash(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, block_q=32, block_kv=32
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=causal, backend="xla").sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_flash_rejects_indivisible_seq():
+    q, k, v = _qkv(S=100)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=64, block_kv=64)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_xla(causal):
+    """Ring attention over a real context axis == single-device attention."""
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(S=64)
+        ref = dot_product_attention(q, k, v, causal=causal, backend="xla")
+        out = ring_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ring_backward_matches_xla():
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(S=64)
+        g1 = jax.grad(lambda q: ring_attention(q, k, v).sum())(q)
+        g2 = jax.grad(
+            lambda q: dot_product_attention(
+                q, k, v, causal=True, backend="xla"
+            ).sum()
+        )(q)
+        np.testing.assert_allclose(g1, g2, atol=5e-5, rtol=5e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ring_falls_back_without_context_axis():
+    set_current_mesh(None)
+    q, k, v = _qkv(S=64)
+    out = ring_attention(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True, backend="xla")
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_trainer_ring_attention_end_to_end():
+    """Full train step with context parallelism: mesh {data:2, context:4},
+    transformer with attention=ring — loss finite and sequence sharded."""
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    prog = V1Program(
+        model=V1ModelSpec(
+            name="transformer_lm",
+            config={"preset": "tiny", "seq_len": 128, "attention": "ring"},
+        ),
+        data=V1DataSpec(
+            name="synthetic_text",
+            batch_size=4,
+            config={"seq_len": 128, "vocab_size": 4096},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=1e-3),
+        train=V1TrainSpec(steps=2, log_every=1, precision="float32"),
+    )
+    trainer = Trainer(prog, mesh_axes={"data": 2, "context": 4})
+    result = trainer.run()
+    assert np.isfinite(result.history[-1]["loss"])
