@@ -290,7 +290,15 @@ def compile_operation(
     )
     context = build_context(params, globs)
 
-    comp_dict = interpolate(component.to_dict(), context)
+    comp_dict = component.to_dict()
+    # DAG children carry their own templates ({{ params.x }}, {{ ops.y }});
+    # they resolve when each child compiles — the parent must not touch them
+    dag_ops = None
+    if comp_dict.get("run", {}).get("kind") == "dag":
+        dag_ops = comp_dict["run"].pop("operations", None)
+    comp_dict = interpolate(comp_dict, context)
+    if dag_ops is not None:
+        comp_dict["run"]["operations"] = dag_ops
     try:
         component = V1Component.model_validate(comp_dict)
     except Exception as e:

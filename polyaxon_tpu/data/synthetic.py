@@ -104,6 +104,7 @@ def _bigram_stream(batch_size, seq_len, vocab, seed, process_index, mlm, mask_ra
             }
 
 
+@register_dataset("synthetic_lm")
 @register_dataset("synthetic_text")
 def synthetic_text(batch_size, config, seed, process_index):
     """Causal-LM token stream (Llama configs): inputs + next-token labels."""

@@ -50,6 +50,7 @@ class TransformerConfig:
     attention_block: int = 512  # kv block size for flash/ring backends
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_targets: tuple = ()  # projection names; empty = all projections
     tie_embeddings: bool = False
     scan_layers: bool = False
 
@@ -117,7 +118,7 @@ class LoRADense(nn.Module):
 
 
 def _proj(cfg: TransformerConfig, features: int, name: str):
-    if cfg.lora_rank > 0:
+    if cfg.lora_rank > 0 and (not cfg.lora_targets or name in cfg.lora_targets):
         return LoRADense(features, rank=cfg.lora_rank, alpha=cfg.lora_alpha, name=name)
     return nn.Dense(features, use_bias=False, name=name)
 
@@ -263,6 +264,20 @@ PRESETS: dict[str, dict] = {
 
 
 def _make_config(config: dict) -> TransformerConfig:
+    config = dict(config)
+    # Polyaxonfile aliases (examples/llama_lora.yaml): variant → preset,
+    # max_len → seq_len, lora: {rank, alpha, targets} → lora_* fields
+    variant = config.pop("variant", None)
+    if variant is not None:
+        config.setdefault("preset", f"llama3-{str(variant).lower()}")
+    if "max_len" in config:
+        config.setdefault("seq_len", config.pop("max_len"))
+    lora = config.pop("lora", None)
+    if isinstance(lora, dict):
+        config.setdefault("lora_rank", int(lora.get("rank", 8)))
+        config.setdefault("lora_alpha", float(lora.get("alpha", 16.0)))
+        if lora.get("targets"):
+            config.setdefault("lora_targets", tuple(lora["targets"]))
     preset = config.pop("preset", None)
     if preset is not None and preset not in PRESETS:
         raise ValueError(f"unknown preset {preset!r}; known: {sorted(PRESETS)}")
@@ -290,6 +305,7 @@ def build_transformer(config: dict) -> ModelBundle:
 
 @register("llama")
 def build_llama(config: dict) -> ModelBundle:
-    config.setdefault("preset", "llama3-8b")
+    if "preset" not in config and "variant" not in config:
+        config["preset"] = "llama3-8b"
     bundle = build_transformer(config)
     return dataclasses.replace(bundle, name="llama")

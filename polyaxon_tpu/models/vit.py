@@ -59,6 +59,9 @@ class ViT(nn.Module):
 
 @register("vit")
 def build_vit(config: dict) -> ModelBundle:
+    variant = config.pop("variant", None)
+    if variant is not None:  # Polyaxonfile alias: "S/16" → preset vit-s16
+        config.setdefault("preset", "vit-" + str(variant).replace("/", "").lower())
     preset = config.pop("preset", None)
     if preset is not None and preset not in PRESETS:
         raise ValueError(f"unknown ViT preset {preset!r}; known: {sorted(PRESETS)}")

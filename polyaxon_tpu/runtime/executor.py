@@ -47,9 +47,14 @@ class Executor:
             compiled.to_dict(),
             tags=compiled.operation.tags,
         )
-        store.set_status(run_uuid, V1Statuses.COMPILED)
-        store.set_status(run_uuid, V1Statuses.QUEUED)
-        store.set_status(run_uuid, V1Statuses.SCHEDULED)
+        # advance through the pre-run lifecycle; skip stages already passed
+        # (agent-submitted runs arrive here in QUEUED, direct runs in CREATED)
+        from ..schemas.lifecycle import can_transition
+
+        for s in (V1Statuses.COMPILED, V1Statuses.QUEUED, V1Statuses.SCHEDULED):
+            current = V1Statuses(store.get_status(run_uuid)["status"])
+            if can_transition(current, s):
+                store.set_status(run_uuid, s)
 
         term = compiled.component.termination
         max_retries = (term.max_retries if term and term.max_retries else 0) or 0

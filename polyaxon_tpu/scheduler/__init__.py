@@ -1,0 +1,9 @@
+"""Scheduler/control plane (SURVEY.md §2 "Control plane", thin local form):
+run queue (queue.py), agent executor loop (agent.py), DAG walker (dag.py).
+The run "db" is the file-backed store (store/local.py); lifecycle legality
+lives in schemas/lifecycle.py and is enforced by the store on every
+transition."""
+
+from .agent import Agent  # noqa: F401
+from .dag import DagError, execute_dag, topo_order  # noqa: F401
+from .queue import RunQueue  # noqa: F401

@@ -1,0 +1,100 @@
+"""The agent: drains the run queue and executes runs.
+
+Reference parity (SURVEY.md §1 "Agent" row + §3 stack (a) boundary #2):
+upstream's agent watches control-plane queues and submits CRDs to the
+cluster. Here the cluster is the local device pool: each claimed run
+executes through runtime/executor.py (in-process JAXJob) — or, when a
+k8s converter target is configured, the rendered manifest is handed to
+`submit_fn` (scheduler/converter.py renders; a real cluster submit needs
+kubectl, which the sandbox lacks, so submit_fn is injectable).
+
+`serve()` is the long-running loop (`polyaxon agent start`); `drain()`
+processes until the queue is empty — used by tests and one-shot CLIs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..compiler.resolver import CompiledOperation, compile_operation
+from ..runtime.executor import Executor
+from ..schemas.lifecycle import V1Statuses
+from ..schemas.operation import V1Operation
+from ..store.local import RunStore
+from .queue import RunQueue
+
+
+class Agent:
+    def __init__(
+        self,
+        store: Optional[RunStore] = None,
+        queue: Optional[RunQueue] = None,
+        submit_fn: Optional[Callable[[CompiledOperation], str]] = None,
+        devices: Optional[list] = None,
+    ):
+        self.store = store or RunStore()
+        self.queue = queue or RunQueue(self.store)
+        self.executor = Executor(store=self.store, devices=devices)
+        self.submit_fn = submit_fn
+
+    def submit(self, op: V1Operation, *, project: str = "default", priority: int = 0) -> str:
+        """Compile + enqueue (the control-plane half of `polyaxon run`)."""
+        compiled = compile_operation(
+            op, project=project, artifacts_root=str(self.store.runs_dir)
+        )
+        self.store.create_run(
+            compiled.run_uuid,
+            compiled.name,
+            compiled.project,
+            compiled.to_dict(),
+            tags=compiled.operation.tags,
+        )
+        self.store.set_status(compiled.run_uuid, V1Statuses.COMPILED)
+        self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
+        self.queue.push(
+            compiled.run_uuid,
+            {"operation": compiled.operation.to_dict(), "project": compiled.project},
+            priority=priority,
+        )
+        return compiled.run_uuid
+
+    def _process(self, entry: dict) -> str:
+        op = V1Operation.model_validate(entry["payload"]["operation"])
+        compiled = compile_operation(
+            op,
+            run_uuid=entry["uuid"],
+            project=entry["payload"].get("project"),
+            artifacts_root=str(self.store.runs_dir),
+        )
+        if self.submit_fn is not None:
+            return self.submit_fn(compiled)
+        return self.executor.execute(compiled)
+
+    def drain(self, max_runs: Optional[int] = None) -> int:
+        """Process queued runs until empty (or max_runs); returns count.
+        A bad entry fails its own run and never kills the loop."""
+        count = 0
+        while max_runs is None or count < max_runs:
+            entry = self.queue.pop()
+            if entry is None:
+                break
+            try:
+                self._process(entry)
+            except Exception as e:  # noqa: BLE001 — record on the run, keep draining
+                uid = entry.get("uuid")
+                try:
+                    self.store.append_log(uid, f"agent: {type(e).__name__}: {e}")
+                    self.store.set_status(
+                        uid, V1Statuses.FAILED, reason=type(e).__name__, message=str(e)
+                    )
+                except Exception:
+                    pass
+            count += 1
+        return count
+
+    def serve(self, poll_interval: float = 1.0, stop_when=lambda: False):
+        """Long-running loop: poll, execute, repeat."""
+        while not stop_when():
+            if self.drain(max_runs=1) == 0:
+                time.sleep(poll_interval)

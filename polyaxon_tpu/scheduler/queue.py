@@ -1,0 +1,75 @@
+"""Persistent run queue: the control plane's pending-work list.
+
+Reference parity (SURVEY.md §2 "Control plane": queues feed the agent).
+File-backed (one JSON line per entry, POSIX lock around mutations) so a
+CLI submit in one process and an agent in another see the same queue —
+the local stand-in for upstream's DB-backed queues.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+from ..store.local import RunStore
+
+
+class RunQueue:
+    def __init__(self, store: Optional[RunStore] = None, name: str = "default"):
+        self.store = store or RunStore()
+        self.path = Path(self.store.home) / "queues" / f"{name}.jsonl"
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.touch(exist_ok=True)
+
+    def _locked(self, fn):
+        with open(self.path, "r+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                entries = [json.loads(line) for line in f if line.strip()]
+                result, entries = fn(entries)
+                f.seek(0)
+                f.truncate()
+                for e in entries:
+                    f.write(json.dumps(e) + "\n")
+                return result
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+
+    def push(self, run_uuid: str, payload: dict[str, Any], priority: int = 0):
+        def fn(entries):
+            entries.append(
+                {"uuid": run_uuid, "priority": priority, "payload": payload}
+            )
+            entries.sort(key=lambda e: -e.get("priority", 0))
+            return None, entries
+
+        self._locked(fn)
+
+    def pop(self) -> Optional[dict]:
+        """Claim the highest-priority entry (None if empty)."""
+
+        def fn(entries):
+            if not entries:
+                return None, entries
+            return entries[0], entries[1:]
+
+        return self._locked(fn)
+
+    def peek_all(self) -> list[dict]:
+        def fn(entries):
+            return list(entries), entries
+
+        return self._locked(fn)
+
+    def remove(self, run_uuid: str) -> bool:
+        def fn(entries):
+            kept = [e for e in entries if e["uuid"] != run_uuid]
+            return len(kept) != len(entries), kept
+
+        return self._locked(fn)
+
+    def __len__(self) -> int:
+        return len(self.peek_all())

@@ -48,6 +48,10 @@ class RunStore:
         meta: Optional[dict] = None,
     ) -> Path:
         run_dir = self.run_dir(run_uuid)
+        if (run_dir / "status.json").exists():
+            # idempotent: agent-submitted runs are created at queue time and
+            # hit the executor's create_run again at execution time
+            return run_dir
         run_dir.mkdir(parents=True, exist_ok=True)
         (run_dir / "outputs").mkdir(exist_ok=True)
         _write_json(run_dir / "spec.json", spec)

@@ -1,0 +1,19 @@
+"""Polytune: hyperparameter search (SURVEY.md §2 "Polytune" row).
+
+Managers (managers.py) turn a V1Matrix spec into suggestion batches; the
+SweepDriver (driver.py) executes them as child runs on disjoint ICI
+sub-slices (placement.py) with early stopping (early_stopping.py).
+"""
+
+from .driver import SweepDriver, SweepResult, TrialResult, run_sweep  # noqa: F401
+from .managers import (  # noqa: F401
+    BayesSearchManager,
+    GridSearchManager,
+    HyperbandManager,
+    HyperoptManager,
+    IterativeManager,
+    MappingManager,
+    RandomSearchManager,
+    Suggestion,
+    build_manager,
+)

@@ -1,0 +1,264 @@
+"""The sweep driver: V1Operation-with-matrix → child runs → best trial.
+
+Reference parity (SURVEY.md §3 stack (b)): upstream runs a tuner auxiliary
+job that polls child metrics and spawns the next batch via the API. Locally
+the loop is in-process: manager.suggest() → compile children with
+`apply_suggestion` → execute (thread pool bounded by `concurrency`, each
+trial pinned to a disjoint ICI sub-slice) → read objective from the run
+store → manager.observe() → repeat.
+
+Hyperband's resource budget is injected as the param named by
+`matrix.resource.name` (conventionally `steps`), so the component's
+Polyaxonfile decides what "resource" means — same contract as upstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from ..compiler.resolver import apply_suggestion, compile_operation
+from ..runtime.executor import Executor
+from ..schemas.lifecycle import V1Statuses
+from ..schemas.operation import V1Operation
+from ..store.local import RunStore
+from .early_stopping import metric_triggered
+from .managers import Suggestion, build_manager
+from .placement import sub_slices
+
+
+@dataclasses.dataclass
+class TrialResult:
+    run_uuid: str
+    params: dict[str, Any]
+    objective: Optional[float]
+    status: str
+
+
+@dataclasses.dataclass
+class SweepResult:
+    sweep_uuid: str
+    trials: list[TrialResult]
+    best: Optional[TrialResult]
+
+
+def _objective_from_store(
+    store: RunStore, run_uuid: str, metric: str
+) -> Optional[float]:
+    """Last logged value of the metric — RAW, exactly as the trial logged it.
+    Sign-flipping for minimize happens only inside manager scoring, never in
+    anything user-facing."""
+    last = None
+    for rec in store.read_metrics(run_uuid):
+        if metric in rec:
+            last = float(rec[metric])
+    return last
+
+
+class SweepDriver:
+    def __init__(
+        self,
+        op: V1Operation,
+        *,
+        store: Optional[RunStore] = None,
+        project: Optional[str] = None,
+        base_dir: Optional[str] = None,
+        devices: Optional[list] = None,
+        log_fn=print,
+    ):
+        if op.matrix is None:
+            raise ValueError("operation has no matrix: nothing to sweep")
+        self.op = op
+        self.matrix = op.matrix
+        self.store = store or RunStore()
+        self.project = project
+        self.base_dir = base_dir
+        self.devices = devices
+        self.log = log_fn
+        metric = getattr(self.matrix, "metric", None)
+        self.metric_name = metric.name if metric else "loss"
+        self.maximize = (metric.optimization if metric else "minimize") == "maximize"
+
+    # ------------------------------------------------------------------
+    def run(self) -> SweepResult:
+        import uuid as _uuid
+
+        sweep_uuid = _uuid.uuid4().hex
+        mgr = build_manager(self.matrix)
+        self.store.create_run(
+            sweep_uuid,
+            (self.op.name or "sweep") + "-sweep",
+            self.project or "default",
+            {"matrix": self.matrix.to_dict()},
+            tags=["sweep"],
+        )
+        for s in (
+            V1Statuses.COMPILED,
+            V1Statuses.QUEUED,
+            V1Statuses.SCHEDULED,
+            V1Statuses.RUNNING,
+        ):
+            self.store.set_status(sweep_uuid, s)
+        trials: list[TrialResult] = []
+        iteration = 0
+        try:
+            while not mgr.done:
+                batch = mgr.suggest()
+                if not batch:
+                    break
+                results = self._run_batch(batch, sweep_uuid, iteration)
+                mgr.observe([(s, self._score(r)) for s, r in results])
+                trials.extend(r for _, r in results)
+                iteration += 1
+                stop_early = any(
+                    r.objective is not None
+                    and metric_triggered(
+                        self.matrix.early_stopping,
+                        {self.metric_name: r.objective},
+                    )
+                    for _, r in results
+                )
+                self.store.log_event(
+                    sweep_uuid,
+                    "sweep_iteration",
+                    {
+                        "iteration": iteration,
+                        "trials": len(trials),
+                        "best": self._best(trials).objective
+                        if self._best(trials)
+                        else None,
+                    },
+                )
+                if stop_early:
+                    self.log("early stopping: metric threshold crossed")
+                    break
+        except BaseException as e:
+            self.store.set_status(sweep_uuid, V1Statuses.FAILED, message=str(e))
+            raise
+        best = self._best(trials)
+        self.store.log_event(
+            sweep_uuid,
+            "sweep_summary",
+            {
+                "trials": len(trials),
+                "best_params": best.params if best else None,
+                "best_objective": best.objective if best else None,
+            },
+        )
+        self.store.set_status(sweep_uuid, V1Statuses.SUCCEEDED)
+        return SweepResult(sweep_uuid=sweep_uuid, trials=trials, best=best)
+
+    def _score(self, trial: TrialResult) -> Optional[float]:
+        """Manager-facing score: higher is better."""
+        if trial.objective is None:
+            return None
+        return trial.objective if self.maximize else -trial.objective
+
+    def _best(self, trials) -> Optional[TrialResult]:
+        scored = [t for t in trials if t.objective is not None]
+        return max(scored, key=self._score) if scored else None
+
+    # ------------------------------------------------------------------
+    def _run_batch(
+        self, batch: list[Suggestion], sweep_uuid: str, iteration: int
+    ) -> list[tuple[Suggestion, TrialResult]]:
+        concurrency = self.matrix.concurrency or 1
+        slices = (
+            sub_slices(concurrency, self.devices) if concurrency > 1 else [self.devices]
+        )
+        concurrency = max(1, len(slices))
+        if concurrency == 1:
+            return [
+                (s, self._run_trial(s, sweep_uuid, iteration, slices[0]))
+                for s in batch
+            ]
+        # each worker checks a sub-slice out of the pool and returns it when
+        # the trial ends — two live trials can never share devices, whatever
+        # order the pool completes in
+        import queue as _queue
+
+        free: _queue.Queue = _queue.Queue()
+        for sl in slices:
+            free.put(sl)
+
+        def one(sug):
+            devices = free.get()
+            try:
+                return sug, self._run_trial(sug, sweep_uuid, iteration, devices)
+            finally:
+                free.put(devices)
+
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            return list(pool.map(one, batch))
+
+    def _run_trial(
+        self, sug: Suggestion, sweep_uuid: str, iteration: int, devices
+    ) -> TrialResult:
+        params = sug.run_params()
+        if sug.resource is not None:
+            name = self.matrix.resource.name
+            value = sug.resource
+            params[name] = int(value) if self.matrix.resource.type == "int" else value
+        child_op = apply_suggestion(self.op, params)
+        compiled = compile_operation(
+            child_op,
+            project=self.project,
+            base_dir=self.base_dir,
+            iteration=iteration,
+        )
+        self.log(
+            f"trial {compiled.run_uuid[:8]} params={params}"
+            + (f" [bracket {sug.bracket} rung {sug.rung}]" if sug.bracket is not None else "")
+        )
+        executor = Executor(store=self.store, devices=devices)
+        status = executor.execute(compiled)
+        objective = _objective_from_store(
+            self.store, compiled.run_uuid, self.metric_name
+        )
+        return TrialResult(
+            run_uuid=compiled.run_uuid,
+            params=params,
+            objective=objective,
+            status=status,
+        )
+
+
+def run_sweep(
+    op: V1Operation,
+    *,
+    store: Optional[RunStore] = None,
+    project: Optional[str] = None,
+    base_dir: Optional[str] = None,
+    devices: Optional[list] = None,
+    log_fn=print,
+) -> dict:
+    """CLI-facing wrapper: run the sweep, return a JSON-able summary."""
+    driver = SweepDriver(
+        op,
+        store=store,
+        project=project,
+        base_dir=base_dir,
+        devices=devices,
+        log_fn=log_fn,
+    )
+    result = driver.run()
+    return {
+        "sweep": result.sweep_uuid,
+        "trials": [
+            {
+                "uuid": t.run_uuid,
+                "params": t.params,
+                "objective": t.objective,
+                "status": str(t.status),
+            }
+            for t in result.trials
+        ],
+        "best": {
+            "uuid": result.best.run_uuid,
+            "params": result.best.params,
+            "objective": result.best.objective,
+        }
+        if result.best
+        else None,
+    }

@@ -1,0 +1,405 @@
+"""Polytune search managers: matrix spec → suggestion batches.
+
+Reference parity (SURVEY.md §2 "Polytune"): grid, random, hyperband (bracket
+math), bayes (GP + acquisition), hyperopt (TPE), iterative, mapping. All
+pure numpy + seeded — unit tests assert exact schedules (§4).
+
+The manager protocol is iteration-based, matching the reference's tuner
+loop (§3 stack (b)):
+    mgr = build_manager(matrix)
+    while not mgr.done:
+        batch = mgr.suggest()                      # list[Suggestion]
+        ... run them, collect metric per trial ...
+        mgr.observe([(suggestion, metric), ...])
+Suggestions carry the param dict plus bookkeeping (bracket/rung for
+hyperband, the resource budget to inject).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+from ..schemas.matrix import (
+    V1Bayes,
+    V1GridSearch,
+    V1Hyperband,
+    V1Hyperopt,
+    V1Iterative,
+    V1Mapping,
+    V1Matrix,
+    V1RandomSearch,
+)
+from .space import (
+    from_unit,
+    grid_configs,
+    param_bounds,
+    sample_config,
+    to_unit,
+)
+
+
+@dataclasses.dataclass
+class Suggestion:
+    params: dict[str, Any]
+    # hyperband bookkeeping; None elsewhere
+    bracket: Optional[int] = None
+    rung: Optional[int] = None
+    resource: Optional[float] = None
+
+    def run_params(self) -> dict[str, Any]:
+        return dict(self.params)
+
+
+class SearchManager:
+    matrix: V1Matrix
+
+    @property
+    def done(self) -> bool:
+        raise NotImplementedError
+
+    def suggest(self) -> list[Suggestion]:
+        raise NotImplementedError
+
+    def observe(self, results: list[tuple[Suggestion, Optional[float]]]) -> None:
+        """results: (suggestion, objective) — objective already sign-fixed so
+        HIGHER IS BETTER; None = trial failed."""
+
+
+class GridSearchManager(SearchManager):
+    def __init__(self, matrix: V1GridSearch):
+        self.matrix = matrix
+        configs = grid_configs(matrix.params)
+        if matrix.num_runs:
+            configs = configs[: matrix.num_runs]
+        self._batch = [Suggestion(params=c) for c in configs]
+        self._served = False
+
+    @property
+    def done(self) -> bool:
+        return self._served
+
+    def suggest(self) -> list[Suggestion]:
+        self._served = True
+        return list(self._batch)
+
+
+class RandomSearchManager(SearchManager):
+    def __init__(self, matrix: V1RandomSearch):
+        self.matrix = matrix
+        self._served = False
+        self._rng = np.random.default_rng(matrix.seed or 0)
+
+    @property
+    def done(self) -> bool:
+        return self._served
+
+    def suggest(self) -> list[Suggestion]:
+        self._served = True
+        return [
+            Suggestion(params=sample_config(self.matrix.params, self._rng))
+            for _ in range(self.matrix.num_runs)
+        ]
+
+
+class MappingManager(SearchManager):
+    def __init__(self, matrix: V1Mapping):
+        self.matrix = matrix
+        self._served = False
+
+    @property
+    def done(self) -> bool:
+        return self._served
+
+    def suggest(self) -> list[Suggestion]:
+        self._served = True
+        return [Suggestion(params=dict(v)) for v in self.matrix.values]
+
+
+class HyperbandManager(SearchManager):
+    """Li et al. Hyperband. R = max_iterations (max resource per config),
+    eta = downsampling. Brackets s = s_max..0; bracket s starts with
+    n = ceil((s_max+1)/(s+1) * eta^s) configs at resource r = R * eta^-s,
+    and successive-halves keeping top 1/eta per rung.
+
+    Suggestion flow: one `suggest()` call per rung; `observe()` feeds that
+    rung's objectives back, the manager promotes the top performers into the
+    next rung (same bracket), then moves to the next bracket."""
+
+    def __init__(self, matrix: V1Hyperband):
+        self.matrix = matrix
+        self._rng = np.random.default_rng(matrix.seed or 0)
+        self.R = float(matrix.max_iterations)
+        self.eta = float(matrix.eta)
+        self.s_max = int(math.floor(math.log(self.R) / math.log(self.eta)))
+        self._brackets = list(range(self.s_max, -1, -1))
+        self._bracket_idx = 0
+        self._rung = 0
+        self._pending: Optional[list[Suggestion]] = None  # current rung configs
+        self._promoted: Optional[list[dict]] = None
+
+    # bracket geometry -------------------------------------------------
+    def bracket_n(self, s: int) -> int:
+        return int(math.ceil((self.s_max + 1) / (s + 1) * self.eta**s))
+
+    def bracket_r(self, s: int) -> float:
+        return self.R * self.eta**-s
+
+    def rung_n(self, s: int, i: int) -> int:
+        return int(math.floor(self.bracket_n(s) * self.eta**-i))
+
+    def rung_r(self, s: int, i: int) -> float:
+        r = self.bracket_r(s) * self.eta**i
+        if self.matrix.resource.type == "int":
+            return float(int(round(r)))
+        return r
+
+    @property
+    def done(self) -> bool:
+        return self._bracket_idx >= len(self._brackets)
+
+    def suggest(self) -> list[Suggestion]:
+        s = self._brackets[self._bracket_idx]
+        i = self._rung
+        n_i = self.rung_n(s, i)
+        r_i = self.rung_r(s, i)
+        if i == 0:
+            configs = [
+                sample_config(self.matrix.params, self._rng) for _ in range(n_i)
+            ]
+        else:
+            configs = self._promoted[:n_i]
+        self._pending = [
+            Suggestion(params=c, bracket=s, rung=i, resource=r_i) for c in configs
+        ]
+        return list(self._pending)
+
+    def observe(self, results):
+        s = self._brackets[self._bracket_idx]
+        scored = [(sug, obj) for sug, obj in results if obj is not None]
+        scored.sort(key=lambda t: t[1], reverse=True)
+        keep = self.rung_n(s, self._rung + 1)
+        self._promoted = [sug.params for sug, _ in scored[:keep]]
+        # advance: next rung while it holds >=1 config AND something was
+        # promoted into it (an all-failed rung abandons this bracket only —
+        # later brackets run at higher resource and may well succeed)
+        if (
+            self._promoted
+            and self._rung + 1 <= s
+            and self.rung_n(s, self._rung + 1) >= 1
+        ):
+            self._rung += 1
+        else:
+            self._bracket_idx += 1
+            self._rung = 0
+            self._promoted = None
+
+
+class BayesSearchManager(SearchManager):
+    """GP (RBF kernel, unit-cube encoding) + UCB/EI/PI acquisition maximized
+    over seeded random candidates. num_initial_runs random warmup points,
+    then max_iterations suggestions of one point each."""
+
+    def __init__(self, matrix: V1Bayes):
+        self.matrix = matrix
+        self._rng = np.random.default_rng(matrix.seed or 0)
+        self._names = sorted(matrix.params)
+        self._X: list[list[float]] = []  # unit-cube encodings
+        self._y: list[float] = []
+        self._iteration = 0
+        util = dict(matrix.utility_function or {})
+        self._acq = str(
+            util.get("acquisition_function", util.get("acquisitionFunction", "ucb"))
+        )
+        self._kappa = float(util.get("kappa", 2.576))
+        self._eps = float(util.get("eps", 0.0))
+
+    @property
+    def done(self) -> bool:
+        return self._iteration >= self.matrix.max_iterations + 1
+
+    def _encode(self, cfg: dict) -> list[float]:
+        return [to_unit(self.matrix.params[n], cfg[n]) for n in self._names]
+
+    def _decode(self, u: np.ndarray) -> dict:
+        return {
+            n: from_unit(self.matrix.params[n], float(u[i]))
+            for i, n in enumerate(self._names)
+        }
+
+    def suggest(self) -> list[Suggestion]:
+        if self._iteration == 0:  # warmup batch
+            return [
+                Suggestion(params=sample_config(self.matrix.params, self._rng))
+                for _ in range(self.matrix.num_initial_runs)
+            ]
+        u = self._maximize_acquisition()
+        return [Suggestion(params=self._decode(u))]
+
+    def observe(self, results):
+        for sug, obj in results:
+            if obj is None:
+                continue
+            self._X.append(self._encode(sug.params))
+            self._y.append(float(obj))
+        self._iteration += 1
+
+    # GP machinery ----------------------------------------------------
+    def _gp_posterior(self, Xs: np.ndarray):
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        mu0 = y.mean() if len(y) else 0.0
+        sig0 = y.std() + 1e-9 if len(y) else 1.0
+        yn = (y - mu0) / sig0
+        ls, noise = 0.2, 1e-6
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / ls**2)
+
+        K = k(X, X) + noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = k(X, Xs)  # [n, m]
+        mu = Ks.T @ alpha
+        v = np.linalg.solve(L, Ks)
+        var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+        return mu * sig0 + mu0, np.sqrt(var) * sig0
+
+    def _maximize_acquisition(self) -> np.ndarray:
+        m = 512
+        cand = self._rng.random((m, len(self._names)))
+        if not self._X:
+            return cand[0]
+        mu, sd = self._gp_posterior(cand)
+        best = max(self._y)
+        if self._acq == "ucb":
+            score = mu + self._kappa * sd
+        elif self._acq == "ei":
+            z = (mu - best - self._eps) / sd
+            score = (mu - best - self._eps) * _ncdf(z) + sd * _npdf(z)
+        elif self._acq == "pi":
+            score = _ncdf((mu - best - self._eps) / sd)
+        else:
+            raise ValueError(f"unknown acquisition {self._acq!r}")
+        return cand[int(np.argmax(score))]
+
+
+def _ncdf(z):
+    return 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+
+
+def _npdf(z):
+    return np.exp(-0.5 * z**2) / math.sqrt(2 * math.pi)
+
+
+class HyperoptManager(SearchManager):
+    """TPE ('tpe'), annealing ('anneal'), or random ('rand') — numpy-only
+    stand-ins for the hyperopt algorithms the reference shells out to."""
+
+    def __init__(self, matrix: V1Hyperopt):
+        self.matrix = matrix
+        self._rng = np.random.default_rng(matrix.seed or 0)
+        self._names = sorted(matrix.params)
+        self._X: list[list[float]] = []
+        self._y: list[float] = []
+        self._count = 0
+        self._warmup = max(4, matrix.num_runs // 4)
+
+    @property
+    def done(self) -> bool:
+        return self._count >= self.matrix.num_runs
+
+    def suggest(self) -> list[Suggestion]:
+        algo = self.matrix.algorithm
+        if algo == "rand" or self._count < self._warmup or not self._X:
+            cfg = sample_config(self.matrix.params, self._rng)
+            return [Suggestion(params=cfg)]
+        if algo == "anneal":
+            u = self._anneal_point()
+        else:
+            u = self._tpe_point()
+        cfg = {
+            n: from_unit(self.matrix.params[n], float(u[i]))
+            for i, n in enumerate(self._names)
+        }
+        return [Suggestion(params=cfg)]
+
+    def observe(self, results):
+        for sug, obj in results:
+            self._count += 1
+            if obj is None:
+                continue
+            self._X.append(
+                [to_unit(self.matrix.params[n], sug.params[n]) for n in self._names]
+            )
+            self._y.append(float(obj))
+
+    def _anneal_point(self) -> np.ndarray:
+        # sample near the best point with shrinking radius
+        best = np.asarray(self._X[int(np.argmax(self._y))])
+        radius = max(0.05, 1.0 / (1 + len(self._y) * 0.3))
+        return np.clip(best + self._rng.normal(0, radius, best.shape), 0, 1)
+
+    def _tpe_point(self) -> np.ndarray:
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        gamma = 0.25
+        n_good = max(1, int(math.ceil(gamma * len(y))))
+        order = np.argsort(-y)  # descending (higher better)
+        good, bad = X[order[:n_good]], X[order[n_good:]]
+        if len(bad) == 0:
+            bad = X
+        bw = 0.15
+        cand = np.clip(
+            good[self._rng.integers(len(good), size=64)]
+            + self._rng.normal(0, bw, (64, X.shape[1])),
+            0,
+            1,
+        )
+
+        def kde(points, xs):
+            d2 = ((xs[:, None, :] - points[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / bw**2).mean(1) + 1e-12
+
+        score = kde(good, cand) / kde(bad, cand)
+        return cand[int(np.argmax(score))]
+
+
+class IterativeManager(SearchManager):
+    """max_iterations rounds of one random suggestion each — the open-loop
+    iterative tuner (the reference delegates per-round logic to a user
+    container; locally each round just resamples)."""
+
+    def __init__(self, matrix: V1Iterative):
+        self.matrix = matrix
+        self._rng = np.random.default_rng(matrix.seed or 0)
+        self._iteration = 0
+
+    @property
+    def done(self) -> bool:
+        return self._iteration >= self.matrix.max_iterations
+
+    def suggest(self) -> list[Suggestion]:
+        return [Suggestion(params=sample_config(self.matrix.params, self._rng))]
+
+    def observe(self, results):
+        self._iteration += 1
+
+
+def build_manager(matrix: V1Matrix) -> SearchManager:
+    managers = {
+        "grid": GridSearchManager,
+        "random": RandomSearchManager,
+        "mapping": MappingManager,
+        "hyperband": HyperbandManager,
+        "bayes": BayesSearchManager,
+        "hyperopt": HyperoptManager,
+        "iterative": IterativeManager,
+    }
+    if matrix.kind not in managers:
+        raise ValueError(f"no search manager for matrix kind {matrix.kind!r}")
+    return managers[matrix.kind](matrix)

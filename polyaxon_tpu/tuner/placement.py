@@ -1,0 +1,40 @@
+"""Topology-aware trial placement: pack concurrent Polytune trials onto
+disjoint sub-slices of the device pool (BASELINE north star: trials ride
+ICI-local sub-slices, e.g. v5e-32 → 4 disjoint v5e-8 groups).
+
+Legal sub-slice sizes are powers of the torus dims; we approximate with
+contiguous equal splits of the `mesh_utils`-ordered device list, which
+preserves ICI locality (device order follows physical coords), and refuse
+splits that would leave a trial with a non-divisor share."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def sub_slices(
+    n_trials: int, devices: Optional[list] = None
+) -> list[list]:
+    """Partition devices into n_trials equal ICI-contiguous groups.
+
+    Returns fewer groups than requested when devices don't divide: the
+    caller then throttles trial concurrency to len(result)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n_trials <= 0:
+        raise ValueError("n_trials must be positive")
+    group = max(1, n // n_trials)
+    # keep groups equal-sized: drop the ragged tail trials, never split a
+    # device between trials
+    n_groups = min(n_trials, n // group)
+    try:
+        from jax.experimental import mesh_utils
+
+        ordered = list(
+            mesh_utils.create_device_mesh((n,), devices=devices).flatten()
+        )
+    except Exception:
+        ordered = list(devices)
+    return [ordered[i * group : (i + 1) * group] for i in range(n_groups)]

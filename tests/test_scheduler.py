@@ -1,0 +1,207 @@
+"""Scheduler tests: DAG topology/triggers/ops-context, queue persistence,
+agent submit→drain — the control-plane loop without a cluster
+(SURVEY.md §4: reference tests the scheduler state machine the same way)."""
+
+import pytest
+
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.runtime.executor import Executor
+from polyaxon_tpu.scheduler import Agent, DagError, RunQueue, topo_order
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.schemas.run_kinds import V1OperationRef
+from polyaxon_tpu.store.local import RunStore
+
+
+def _ref(name, deps=None, trigger=None):
+    return V1OperationRef(
+        name=name, depends_on=deps, trigger=trigger, component={"kind": "component"}
+    )
+
+
+def test_topo_order_waves():
+    nodes = {
+        "a": _ref("a"),
+        "b": _ref("b", deps=["a"]),
+        "c": _ref("c", deps=["a"]),
+        "d": _ref("d", deps=["b", "c"]),
+    }
+    assert topo_order(nodes) == [["a"], ["b", "c"], ["d"]]
+
+
+def test_topo_order_cycle_raises():
+    nodes = {"a": _ref("a", deps=["b"]), "b": _ref("b", deps=["a"])}
+    with pytest.raises(DagError, match="cycle"):
+        topo_order(nodes)
+
+
+def test_topo_order_unknown_dep_raises():
+    with pytest.raises(DagError, match="unknown"):
+        topo_order({"a": _ref("a", deps=["ghost"])})
+
+
+MLP_COMPONENT = {
+    "kind": "component",
+    "name": "step",
+    "inputs": [{"name": "lr", "type": "float", "value": 0.01}],
+    "run": {
+        "kind": "jaxjob",
+        "program": {
+            "model": {"name": "mlp", "config": {"input_dim": 16, "num_classes": 2, "hidden": [8]}},
+            "data": {"name": "synthetic", "batchSize": 8, "config": {"shape": [16], "num_classes": 2}},
+            "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+            "train": {"steps": 2, "logEvery": 1, "precision": "float32"},
+        },
+    },
+}
+
+
+def _dag_yaml(tmp_path, text):
+    p = tmp_path / "dag.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_dag_executes_chain_with_ops_context(tmp_home, tmp_path):
+    import json
+    import yaml
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "pipeline",
+        "component": {
+            "kind": "component",
+            "name": "pipeline",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {"name": "first", "component": MLP_COMPONENT},
+                    {
+                        "name": "second",
+                        "dependsOn": ["first"],
+                        "component": MLP_COMPONENT,
+                        # downstream consumes upstream's final loss as its lr
+                        "params": {"lr": {"value": "{{ ops.first.outputs.loss }}"}},
+                    },
+                ],
+            },
+        },
+    }
+    path = _dag_yaml(tmp_path, yaml.safe_dump(spec))
+    op = read_polyaxonfile(path)
+    from polyaxon_tpu.compiler.resolver import compile_operation
+
+    store = RunStore()
+    compiled = compile_operation(op)
+    status = Executor(store).execute(compiled)
+    assert status == V1Statuses.SUCCEEDED
+    runs = store.list_runs()
+    assert len(runs) == 3  # dag + 2 children
+
+
+def test_dag_upstream_failure_skips_downstream(tmp_home, tmp_path):
+    import yaml
+
+    bad = {
+        "kind": "component",
+        "name": "bad",
+        "run": {"kind": "job", "container": {"command": ["false"]}},
+    }
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "pipeline",
+        "component": {
+            "kind": "component",
+            "name": "pipeline",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {"name": "boom", "component": bad},
+                    {"name": "after", "dependsOn": ["boom"], "component": MLP_COMPONENT},
+                ],
+            },
+        },
+    }
+    op = read_polyaxonfile(_dag_yaml(tmp_path, yaml.safe_dump(spec)))
+    from polyaxon_tpu.compiler.resolver import compile_operation
+
+    store = RunStore()
+    status = Executor(store).execute(compile_operation(op))
+    assert status == V1Statuses.FAILED
+    # only boom + dag ran; 'after' was never compiled into a run
+    assert len(store.list_runs()) == 2
+
+
+def test_dag_all_done_trigger_runs_after_failure(tmp_home, tmp_path):
+    import yaml
+
+    bad = {
+        "kind": "component",
+        "name": "bad",
+        "run": {"kind": "job", "container": {"command": ["false"]}},
+    }
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "pipeline",
+        "component": {
+            "kind": "component",
+            "name": "pipeline",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {"name": "boom", "component": bad},
+                    {
+                        "name": "cleanup",
+                        "dependsOn": ["boom"],
+                        "trigger": "all_done",
+                        "component": MLP_COMPONENT,
+                    },
+                ],
+            },
+        },
+    }
+    op = read_polyaxonfile(_dag_yaml(tmp_path, yaml.safe_dump(spec)))
+    from polyaxon_tpu.compiler.resolver import compile_operation
+
+    store = RunStore()
+    try:
+        Executor(store).execute(compile_operation(op))
+    except Exception:
+        pass
+    # cleanup DID run despite boom failing
+    names = {r["name"] for r in store.list_runs()}
+    assert any("cleanup" in n for n in names)
+
+
+def test_queue_priority_and_persistence(tmp_home):
+    store = RunStore()
+    q = RunQueue(store)
+    q.push("low", {"operation": {}}, priority=0)
+    q.push("high", {"operation": {}}, priority=10)
+    assert len(q) == 2
+    # a second handle on the same home sees the same queue (persistence)
+    q2 = RunQueue(RunStore())
+    assert q2.pop()["uuid"] == "high"
+    assert q.pop()["uuid"] == "low"
+    assert q.pop() is None
+
+
+def test_agent_submit_and_drain(tmp_home, tmp_path):
+    import yaml
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "agent-run",
+        "component": MLP_COMPONENT,
+    }
+    op = read_polyaxonfile(_dag_yaml(tmp_path, yaml.safe_dump(spec)))
+    store = RunStore()
+    agent = Agent(store=store)
+    uid = agent.submit(op)
+    assert store.get_status(uid)["status"] == V1Statuses.QUEUED
+    assert agent.drain() == 1
+    assert store.get_status(uid)["status"] == V1Statuses.SUCCEEDED
+    assert len(agent.queue) == 0

@@ -1,0 +1,280 @@
+"""Polytune tests: seeded managers produce deterministic schedules
+(SURVEY.md §4: reference tests tuners with fixed seeds), hyperband bracket
+math matches Li et al., and an end-to-end sweep finds the better config."""
+
+import math
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.schemas.matrix import parse_matrix
+from polyaxon_tpu.tuner import (
+    HyperbandManager,
+    build_manager,
+)
+from polyaxon_tpu.tuner.early_stopping import (
+    median_should_stop,
+    metric_triggered,
+    truncation_should_stop,
+)
+from polyaxon_tpu.tuner.placement import sub_slices
+from polyaxon_tpu.tuner.space import from_unit, grid_configs, to_unit
+
+
+PARAMS = {
+    "lr": {"kind": "loguniform", "value": {"low": math.log(1e-4), "high": math.log(1e-1)}},
+    "width": {"kind": "choice", "value": [64, 128, 256]},
+}
+
+
+def test_grid_enumeration_exact():
+    m = parse_matrix(
+        {
+            "kind": "grid",
+            "params": {
+                "a": {"kind": "choice", "value": [1, 2]},
+                "b": {"kind": "linspace", "value": {"start": 0.0, "stop": 1.0, "num": 3}},
+            },
+        }
+    )
+    mgr = build_manager(m)
+    batch = mgr.suggest()
+    assert mgr.done
+    got = [(s.params["a"], s.params["b"]) for s in batch]
+    assert got == [
+        (1, 0.0), (1, 0.5), (1, 1.0),
+        (2, 0.0), (2, 0.5), (2, 1.0),
+    ]
+
+
+def test_random_seeded_deterministic():
+    spec = {"kind": "random", "params": PARAMS, "num_runs": 5, "seed": 7}
+    a = [s.params for s in build_manager(parse_matrix(spec)).suggest()]
+    b = [s.params for s in build_manager(parse_matrix(spec)).suggest()]
+    assert a == b
+    assert len(a) == 5
+    for cfg in a:
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert cfg["width"] in (64, 128, 256)
+
+
+def test_hyperband_bracket_math():
+    """R=9, eta=3 → s_max=2; brackets (s=2: n=9,r=1), (s=1: n=5,r=3),
+    (s=0: n=3,r=9) — the canonical Li et al. schedule."""
+    m = parse_matrix(
+        {
+            "kind": "hyperband",
+            "params": PARAMS,
+            "maxIterations": 9,
+            "eta": 3,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "seed": 1,
+        }
+    )
+    mgr = HyperbandManager(m)
+    assert mgr.s_max == 2
+    assert [mgr.bracket_n(s) for s in (2, 1, 0)] == [9, 5, 3]
+    assert [mgr.bracket_r(s) for s in (2, 1, 0)] == [1.0, 3.0, 9.0]
+
+    # bracket s=2 rung schedule: (9 cfgs @ r=1) -> (3 @ 3) -> (1 @ 9)
+    batch = mgr.suggest()
+    assert len(batch) == 9 and batch[0].resource == 1.0
+    # feed objectives: config i gets objective -i (lower index better)
+    mgr.observe([(s, -float(i)) for i, s in enumerate(batch)])
+    rung1 = mgr.suggest()
+    assert len(rung1) == 3 and rung1[0].resource == 3.0
+    # promoted = the 3 best (indices 0,1,2 of the original batch)
+    assert [r.params for r in rung1] == [b.params for b in batch[:3]]
+    mgr.observe([(s, 0.0) for s in rung1])
+    rung2 = mgr.suggest()
+    assert len(rung2) == 1 and rung2[0].resource == 9.0
+    mgr.observe([(s, 0.0) for s in rung2])
+    # next bracket s=1
+    b2 = mgr.suggest()
+    assert len(b2) == 5 and b2[0].resource == 3.0 and b2[0].bracket == 1
+
+
+def test_hyperband_full_run_terminates():
+    m = parse_matrix(
+        {
+            "kind": "hyperband",
+            "params": PARAMS,
+            "maxIterations": 27,
+            "eta": 3,
+            "resource": {"name": "steps"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "seed": 3,
+        }
+    )
+    mgr = build_manager(m)
+    total = 0
+    rng = np.random.default_rng(0)
+    for _ in range(100):
+        if mgr.done:
+            break
+        batch = mgr.suggest()
+        total += len(batch)
+        mgr.observe([(s, float(rng.random())) for s in batch])
+    assert mgr.done
+    assert total > 30  # 4 brackets worth of trials
+
+
+def test_bayes_warmup_then_model_based():
+    m = parse_matrix(
+        {
+            "kind": "bayes",
+            "params": PARAMS,
+            "numInitialRuns": 4,
+            "maxIterations": 3,
+            "metric": {"name": "acc", "optimization": "maximize"},
+            "seed": 5,
+        }
+    )
+    mgr = build_manager(m)
+    warmup = mgr.suggest()
+    assert len(warmup) == 4
+    # objective favors high lr
+    mgr.observe([(s, math.log10(s.params["lr"])) for s in warmup])
+    seen = []
+    while not mgr.done:
+        batch = mgr.suggest()
+        assert len(batch) == 1
+        seen.append(batch[0].params["lr"])
+        mgr.observe([(batch[0], math.log10(batch[0].params["lr"]))])
+    assert len(seen) == 3
+    for lr in seen:
+        assert 1e-4 <= lr <= 1e-1
+
+
+def test_tpe_improves_on_quadratic():
+    m = parse_matrix(
+        {
+            "kind": "hyperopt",
+            "params": {"x": {"kind": "uniform", "value": {"low": 0.0, "high": 1.0}}},
+            "numRuns": 40,
+            "algorithm": "tpe",
+            "metric": {"name": "obj", "optimization": "maximize"},
+            "seed": 11,
+        }
+    )
+    mgr = build_manager(m)
+    xs = []
+    while not mgr.done:
+        batch = mgr.suggest()
+        res = []
+        for s in batch:
+            x = s.params["x"]
+            xs.append(x)
+            res.append((s, -((x - 0.7) ** 2)))  # optimum at 0.7
+        mgr.observe(res)
+    late = xs[-10:]
+    assert abs(np.mean(late) - 0.7) < 0.2  # concentrated near optimum
+
+
+def test_mapping_and_iterative():
+    m = parse_matrix({"kind": "mapping", "values": [{"a": 1}, {"a": 2}]})
+    mgr = build_manager(m)
+    assert [s.params for s in mgr.suggest()] == [{"a": 1}, {"a": 2}]
+    assert mgr.done
+
+    it = build_manager(
+        parse_matrix({"kind": "iterative", "params": PARAMS, "maxIterations": 3, "seed": 2})
+    )
+    count = 0
+    while not it.done:
+        batch = it.suggest()
+        count += len(batch)
+        it.observe([(s, None) for s in batch])
+    assert count == 3
+
+
+def test_unit_encoding_roundtrip():
+    from polyaxon_tpu.schemas.matrix import parse_matrix as _pm
+
+    grid = _pm({"kind": "grid", "params": {
+        "c": {"kind": "choice", "value": ["a", "b", "c"]},
+    }}).params["c"]
+    for v in ("a", "b", "c"):
+        assert from_unit(grid, to_unit(grid, v)) == v
+
+
+def test_early_stopping_policies():
+    from polyaxon_tpu.schemas.matrix import (
+        V1MedianStoppingPolicy,
+        V1MetricEarlyStopping,
+        V1TruncationStoppingPolicy,
+    )
+
+    es = [V1MetricEarlyStopping(metric="acc", value=0.95, optimization="maximize")]
+    assert metric_triggered(es, {"acc": 0.96})
+    assert not metric_triggered(es, {"acc": 0.5})
+    assert not metric_triggered(es, {"loss": 0.1})
+
+    med = V1MedianStoppingPolicy(evaluation_interval=1)
+    assert median_should_stop(med, [0.1], [0.5, 0.6, 0.7], maximize=True)
+    assert not median_should_stop(med, [0.9], [0.5, 0.6, 0.7], maximize=True)
+
+    trunc = V1TruncationStoppingPolicy(percent=50.0)
+    assert truncation_should_stop(trunc, 0.1, [0.1, 0.5, 0.6, 0.9], maximize=True)
+    assert not truncation_should_stop(trunc, 0.9, [0.1, 0.5, 0.6, 0.9], maximize=True)
+
+
+def test_sub_slice_placement():
+    groups = sub_slices(2)
+    assert len(groups) == 2
+    assert all(len(g) == 4 for g in groups)
+    flat = [d.id for g in groups for d in g]
+    assert len(set(flat)) == 8  # disjoint cover
+
+    # 3 trials on 8 devices: equal groups only — 3 groups of 2 (ragged tail
+    # dropped), never unequal splits
+    groups = sub_slices(3)
+    assert all(len(g) == 2 for g in groups)
+
+
+def test_sweep_end_to_end_grid(tmp_home):
+    """Grid sweep over MLP lr: the sweep runs real trials and picks the
+    better configuration."""
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.store.local import RunStore
+    from polyaxon_tpu.tuner import SweepDriver
+
+    import textwrap, tempfile, os
+
+    yaml_text = textwrap.dedent(
+        """
+        version: 1.1
+        kind: operation
+        name: mlp-sweep
+        matrix:
+          kind: grid
+          params:
+            lr:
+              kind: choice
+              value: [0.05, 1.0e-09]
+        component:
+          kind: component
+          name: mlp-train
+          inputs:
+          - {name: lr, type: float, value: 0.001}
+          run:
+            kind: jaxjob
+            program:
+              model: {name: mlp, config: {input_dim: 32, num_classes: 4, hidden: [32]}}
+              data: {name: synthetic, batchSize: 16, config: {shape: [32], num_classes: 4}}
+              optimizer: {name: adamw, learningRate: "{{ params.lr }}"}
+              train: {steps: 6, logEvery: 3, precision: float32}
+        """
+    )
+    path = os.path.join(tempfile.mkdtemp(), "sweep.yaml")
+    with open(path, "w") as f:
+        f.write(yaml_text)
+    op = read_polyaxonfile(path)
+    store = RunStore()
+    result = SweepDriver(op, store=store, log_fn=lambda *a: None).run()
+    assert len(result.trials) == 2
+    assert result.best is not None
+    assert result.best.params["lr"] == 0.05  # learning beats a frozen lr
+    statuses = [t.status for t in result.trials]
+    assert all(s == "succeeded" for s in statuses)
