@@ -1,0 +1,9 @@
+"""Auxiliary container specs (SURVEY.md §2 "Auxiliaries")."""
+
+from .containers import (  # noqa: F401
+    cleaner_container,
+    init_container,
+    notifier_container,
+    sidecar_container,
+    tuner_container,
+)

@@ -1,0 +1,95 @@
+"""Auxiliary container specs: init, sidecar, cleaner, notifier, tuner.
+
+Reference parity (SURVEY.md §2 "Auxiliaries"): the operator wires these
+around the user container in every pod. Rendered here as plain dicts the
+k8s converter embeds; images are configurable (defaults name the in-repo
+CLI image since everything local runs from one wheel)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+DEFAULT_IMAGE = "polyaxon-tpu/cli:latest"
+
+CONTEXT_MOUNT = {"name": "polyaxon-context", "mountPath": "/polyaxon-data"}
+ARTIFACTS_MOUNT = {"name": "polyaxon-artifacts", "mountPath": "/polyaxon-artifacts"}
+
+
+def init_container(
+    *,
+    image: str = DEFAULT_IMAGE,
+    git: Optional[dict] = None,
+    artifacts: Optional[dict] = None,
+    paths: Optional[list[str]] = None,
+    connection: Optional[str] = None,
+) -> dict:
+    """Provisioning container: clones git refs / pulls artifacts into the
+    shared context volume before the main container starts."""
+    args = ["init"]
+    if git:
+        args += ["--git-url", str(git.get("url", ""))]
+        if git.get("revision"):
+            args += ["--git-revision", str(git["revision"])]
+    if artifacts:
+        args += ["--artifacts", str(artifacts)]
+    for p in paths or ():
+        args += ["--path", p]
+    if connection:
+        args += ["--connection", connection]
+    return {
+        "name": "polyaxon-init",
+        "image": image,
+        "command": ["polyaxon-aux"],
+        "args": args,
+        "volumeMounts": [CONTEXT_MOUNT],
+    }
+
+
+def sidecar_container(
+    *,
+    image: str = DEFAULT_IMAGE,
+    run_uuid: str,
+    sync_interval: int = 10,
+) -> dict:
+    """Watches the run's outputs/events dirs and syncs them to the artifact
+    store (stack (c) in SURVEY.md §3)."""
+    return {
+        "name": "polyaxon-sidecar",
+        "image": image,
+        "command": ["polyaxon-aux"],
+        "args": ["sidecar", "--run-uuid", run_uuid, "--interval", str(sync_interval)],
+        "volumeMounts": [CONTEXT_MOUNT, ARTIFACTS_MOUNT],
+    }
+
+
+def cleaner_container(*, image: str = DEFAULT_IMAGE, run_uuid: str) -> dict:
+    return {
+        "name": "polyaxon-cleaner",
+        "image": image,
+        "command": ["polyaxon-aux"],
+        "args": ["cleaner", "--run-uuid", run_uuid],
+        "volumeMounts": [ARTIFACTS_MOUNT],
+    }
+
+
+def notifier_container(
+    *, image: str = DEFAULT_IMAGE, run_uuid: str, targets: Optional[list[str]] = None
+) -> dict:
+    return {
+        "name": "polyaxon-notifier",
+        "image": image,
+        "command": ["polyaxon-aux"],
+        "args": ["notify", "--run-uuid", run_uuid]
+        + [a for t in targets or () for a in ("--target", t)],
+    }
+
+
+def tuner_container(*, image: str = DEFAULT_IMAGE, sweep_uuid: str) -> dict:
+    """The sweep-driving auxiliary job (tuner/driver.py run as a pod)."""
+    return {
+        "name": "polyaxon-tuner",
+        "image": image,
+        "command": ["polyaxon-aux"],
+        "args": ["tuner", "--sweep-uuid", sweep_uuid],
+        "volumeMounts": [ARTIFACTS_MOUNT],
+    }

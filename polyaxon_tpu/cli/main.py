@@ -173,6 +173,63 @@ def ops_stop(uid):
     click.echo(f"{uid[:8]} stopped")
 
 
+@cli.group()
+def streams():
+    """Log/metric/event/artifact streaming service."""
+
+
+@streams.command("start")
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8585, type=int)
+def streams_start(host, port):
+    """Serve the run store over HTTP (logs/metrics/events/artifacts)."""
+    from ..streams import serve
+
+    serve(RunStore(), host=host, port=port)
+
+
+@cli.group()
+def agent():
+    """Cluster-side executor: drains the run queue."""
+
+
+@agent.command("start")
+@click.option("--poll-interval", default=1.0, type=float)
+def agent_start(poll_interval):
+    from ..scheduler import Agent
+
+    click.echo("agent started; polling queue (ctrl-c to stop)")
+    Agent(store=RunStore()).serve(poll_interval=poll_interval)
+
+
+@agent.command("drain")
+def agent_drain():
+    """Process everything queued, then exit."""
+    from ..scheduler import Agent
+
+    n = Agent(store=RunStore()).drain()
+    click.echo(f"processed {n} run(s)")
+
+
+@cli.command()
+@click.option("-f", "--file", "fpath", required=True, type=click.Path(exists=True))
+@click.option("-P", "--param", "params", multiple=True, help="override: name=value")
+@click.option("--namespace", default="polyaxon")
+def convert(fpath, params, namespace):
+    """Render the k8s manifests for a polyaxonfile (TPU topology included)."""
+    from ..k8s import convert_operation
+
+    try:
+        op = read_polyaxonfile(fpath, params=_params_to_dict(params))
+        compiled = compile_operation(op, base_dir=None)
+        manifests = convert_operation(compiled, namespace=namespace)
+    except (PolyaxonfileError, CompilationError) as e:
+        raise click.ClickException(str(e))
+    import yaml as _yaml
+
+    click.echo(_yaml.safe_dump_all(manifests, sort_keys=False))
+
+
 def main():
     cli()
 

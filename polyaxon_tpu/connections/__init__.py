@@ -1,0 +1,7 @@
+"""Connections: artifact stores, git, registries (SURVEY.md §2)."""
+
+from .schemas import (  # noqa: F401
+    ConnectionCatalog,
+    V1Connection,
+    V1ConnectionSpec,
+)

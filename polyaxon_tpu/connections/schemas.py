@@ -1,0 +1,98 @@
+"""Connection schemas: artifact stores, git repos, registries.
+
+Reference parity (SURVEY.md §2 "Connections/fs"): upstream models
+connections (S3/GCS/Azure/volumes/git/registry) that the converter mounts
+into pods and the fs layer reads/writes through. Local-first: the volume
+kinds are fully functional (they are just paths); bucket kinds validate
+and render into pod specs but data-plane access is gated on their SDKs,
+which this image intentionally lacks (zero egress)."""
+
+from __future__ import annotations
+
+from typing import Literal, Optional, Union
+
+from pydantic import Field
+
+from ..schemas.base import BaseSchema
+
+
+class V1HostPathConnection(BaseSchema):
+    kind: Literal["host_path"] = "host_path"
+    host_path: str
+    mount_path: str
+    read_only: Optional[bool] = None
+
+
+class V1VolumeConnection(BaseSchema):
+    kind: Literal["volume_claim"] = "volume_claim"
+    volume_claim: str
+    mount_path: str
+    read_only: Optional[bool] = None
+
+
+class V1BucketConnection(BaseSchema):
+    """S3/GCS/Azure-blob bucket. `bucket` carries the scheme: s3://, gs://,
+    wasb://."""
+
+    kind: Literal["bucket"] = "bucket"
+    bucket: str
+    secret: Optional[str] = None
+
+
+class V1GitConnection(BaseSchema):
+    kind: Literal["git"] = "git"
+    url: str
+    revision: Optional[str] = None
+    flags: Optional[list[str]] = None
+    secret: Optional[str] = None
+
+
+class V1RegistryConnection(BaseSchema):
+    kind: Literal["registry"] = "registry"
+    url: str
+    secret: Optional[str] = None
+
+
+V1ConnectionSpec = Union[
+    V1HostPathConnection,
+    V1VolumeConnection,
+    V1BucketConnection,
+    V1GitConnection,
+    V1RegistryConnection,
+]
+
+
+class V1Connection(BaseSchema):
+    name: str
+    description: Optional[str] = None
+    tags: Optional[list[str]] = None
+    spec: V1ConnectionSpec = Field(discriminator="kind")
+
+    @property
+    def is_artifact_store(self) -> bool:
+        return self.spec.kind in ("host_path", "volume_claim", "bucket")
+
+
+class ConnectionCatalog:
+    """Named connections registered for the deployment (the local stand-in
+    for upstream's agent/settings-level connection catalog)."""
+
+    def __init__(self, connections: Optional[list[V1Connection]] = None):
+        self._by_name = {c.name: c for c in connections or []}
+
+    @classmethod
+    def from_config(cls, entries: list[dict]) -> "ConnectionCatalog":
+        return cls([V1Connection.model_validate(e) for e in entries])
+
+    def get(self, name: str) -> V1Connection:
+        if name not in self._by_name:
+            raise KeyError(
+                f"unknown connection {name!r}; registered: {sorted(self._by_name)}"
+            )
+        return self._by_name[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._by_name)
+
+    def add(self, conn: V1Connection) -> None:
+        self._by_name[conn.name] = conn

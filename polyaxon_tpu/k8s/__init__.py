@@ -1,0 +1,3 @@
+"""K8s conversion layer (SURVEY.md §2 "K8s converter")."""
+
+from .converter import ConversionError, convert_operation  # noqa: F401

@@ -1,0 +1,441 @@
+"""K8s converter: CompiledOperation → cluster manifests with TPU topology.
+
+Reference parity (SURVEY.md §2 "K8s converter", §3 stack (a)): upstream
+renders an Operation CRD whose pods the Go operator creates, delegating
+distributed kinds to Kubeflow CRDs over `nvidia.com/gpu` nodes. The TPU
+rebuild renders directly to core k8s objects with TPU slice scheduling
+(north star: no GPU node anywhere):
+
+- jaxjob → a JobSet-shaped dict: one headless Service for rendezvous plus
+  an indexed Job with one pod per TPU host. Node selectors carry
+  `cloud.google.com/gke-tpu-accelerator` + `gke-tpu-topology`; each pod
+  requests `google.com/tpu: <chips_per_host>`. The pod command is the
+  native gang launcher (one worker per host process), with
+  JAX_COORDINATOR_ADDRESS pointing at pod index 0 through the headless
+  service — exactly the env runtime/worker.py consumes.
+- job → batch/v1 Job; service → apps/v1 Deployment + Service.
+- init/sidecar containers from auxiliaries/containers.py; connections
+  mount via connections/schemas.py.
+
+These manifests are golden-tested (tests/test_k8s.py) — the reference's
+own strategy for testing multi-node without a cluster (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..auxiliaries.containers import (
+    ARTIFACTS_MOUNT,
+    CONTEXT_MOUNT,
+    init_container,
+    sidecar_container,
+)
+from ..compiler.resolver import CompiledOperation
+from ..connections.schemas import ConnectionCatalog
+from ..schemas.environment import CHIPS_PER_HOST, V1TpuSpec
+
+TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+# GKE accelerator names per generation
+TPU_ACCELERATORS = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+class ConversionError(Exception):
+    pass
+
+
+def _run_env(compiled: CompiledOperation) -> list[dict]:
+    return [
+        {"name": "POLYAXON_RUN_UUID", "value": compiled.run_uuid},
+        {"name": "POLYAXON_RUN_NAME", "value": compiled.name},
+        {"name": "POLYAXON_PROJECT", "value": compiled.project},
+        {"name": "POLYAXON_RUN_OUTPUTS_PATH", "value": f"/polyaxon-artifacts/{compiled.run_uuid}/outputs"},
+    ]
+
+
+def _tpu_of(compiled: CompiledOperation) -> Optional[V1TpuSpec]:
+    run = compiled.run
+    env = getattr(run, "environment", None)
+    res = env.resources if env and env.resources else None
+    return getattr(res, "tpu", None) if res else None
+
+
+def _pod_scheduling(env, tpu: Optional[V1TpuSpec]) -> dict:
+    node_selector: dict[str, str] = dict(env.node_selector or {}) if env else {}
+    if tpu is not None:
+        node_selector[TPU_ACCELERATOR_LABEL] = TPU_ACCELERATORS[tpu.type]
+        if tpu.topology:
+            node_selector[TPU_TOPOLOGY_LABEL] = tpu.topology
+    out: dict[str, Any] = {}
+    if node_selector:
+        out["nodeSelector"] = node_selector
+    if env:
+        if env.tolerations:
+            out["tolerations"] = env.tolerations
+        if env.affinity:
+            out["affinity"] = env.affinity
+        if env.service_account_name:
+            out["serviceAccountName"] = env.service_account_name
+        if env.priority_class_name:
+            out["priorityClassName"] = env.priority_class_name
+        if env.host_network is not None:
+            out["hostNetwork"] = env.host_network
+        if env.node_name:
+            out["nodeName"] = env.node_name
+    return out
+
+
+def _volumes(connections: list) -> tuple[list[dict], list[dict]]:
+    """(volumes, extra mounts) from resolved connections + the two standard
+    shared volumes."""
+    volumes = [
+        {"name": "polyaxon-context", "emptyDir": {}},
+        {"name": "polyaxon-artifacts", "emptyDir": {}},
+    ]
+    mounts: list[dict] = []
+    for conn in connections:
+        spec = conn.spec
+        if spec.kind == "host_path":
+            volumes.append(
+                {"name": f"conn-{conn.name}", "hostPath": {"path": spec.host_path}}
+            )
+            mounts.append(
+                {
+                    "name": f"conn-{conn.name}",
+                    "mountPath": spec.mount_path,
+                    "readOnly": bool(spec.read_only),
+                }
+            )
+        elif spec.kind == "volume_claim":
+            volumes.append(
+                {
+                    "name": f"conn-{conn.name}",
+                    "persistentVolumeClaim": {"claimName": spec.volume_claim},
+                }
+            )
+            mounts.append(
+                {
+                    "name": f"conn-{conn.name}",
+                    "mountPath": spec.mount_path,
+                    "readOnly": bool(spec.read_only),
+                }
+            )
+        # bucket/git/registry connections inject env/secrets, not volumes
+    return volumes, mounts
+
+
+def _main_container(compiled: CompiledOperation, tpu, n_hosts: int, port: int) -> dict:
+    run = compiled.run
+    chips_per_host = CHIPS_PER_HOST.get(tpu.type, 4) if tpu else 0
+    c = run.container
+    svc = f"{compiled.name}-hosts"
+    if c is not None and (c.command or c.args):
+        command = list(c.command or [])
+        args = list(c.args or [])
+        image = c.image or "polyaxon-tpu/runtime:latest"
+    else:
+        # native program: the C++ gang launcher supervises one worker
+        # process per host; hosts rendezvous at pod 0 of the headless svc
+        image = "polyaxon-tpu/runtime:latest"
+        command = ["polyaxon-launcher"]
+        args = [
+            "--num-workers", "1",
+            # global rank = this pod's completion index; gang size = hosts
+            "--process-id-offset", "env:JOB_COMPLETION_INDEX",
+            "--total-processes", str(n_hosts),
+            "--coordinator", f"{compiled.name}-0.{svc}:{port}",
+            "--env", "POLYAXON_PROGRAM_SPEC=/polyaxon-context/program.json",
+            "--", "python", "-m", "polyaxon_tpu.runtime.worker",
+        ]
+    container: dict[str, Any] = {
+        "name": "polyaxon-main",
+        "image": image,
+        "command": command,
+        "args": args,
+        "env": _run_env(compiled)
+        + [
+            {"name": "JAX_NUM_PROCESSES", "value": str(n_hosts)},
+            # indexed Jobs also export JOB_COMPLETION_INDEX natively; the
+            # explicit fieldRef keeps the manifest self-describing — the
+            # launcher turns it into each worker's global JAX_PROCESS_ID
+            {
+                "name": "JOB_COMPLETION_INDEX",
+                "valueFrom": {
+                    "fieldRef": {
+                        "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+                    }
+                },
+            },
+            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{compiled.name}-0.{svc}:{port}"},
+        ],
+        "volumeMounts": [CONTEXT_MOUNT, ARTIFACTS_MOUNT],
+        "ports": [{"containerPort": port, "name": "coordinator"}],
+    }
+    if tpu is not None:
+        container["resources"] = {
+            "requests": {"google.com/tpu": str(chips_per_host)},
+            "limits": {"google.com/tpu": str(chips_per_host)},
+        }
+    env_spec = getattr(run, "environment", None)
+    res = env_spec.resources if env_spec and env_spec.resources else None
+    if res is not None:
+        base = container.setdefault("resources", {"requests": {}, "limits": {}})
+        for key in ("cpu", "memory"):
+            v = getattr(res, key, None)
+            if v is not None:
+                base["requests"][key] = str(v)
+                base["limits"][key] = str(v)
+    return container
+
+
+def convert_jaxjob(
+    compiled: CompiledOperation,
+    catalog: Optional[ConnectionCatalog] = None,
+    *,
+    namespace: str = "polyaxon",
+    coordinator_port: int = 12355,
+) -> list[dict]:
+    """JAXJob → [headless Service, indexed Job] — one pod per TPU host."""
+    run = compiled.run
+    tpu = _tpu_of(compiled)
+    if tpu is not None:
+        n_hosts = max(1, tpu.num_chips // CHIPS_PER_HOST.get(tpu.type, 4))
+    else:
+        n_hosts = int(getattr(run, "replicas", 1) or 1)
+    env = getattr(run, "environment", None)
+    conns = _resolve_connections(run, catalog)
+    volumes, conn_mounts = _volumes(conns)
+    main = _main_container(compiled, tpu, n_hosts, coordinator_port)
+    main["volumeMounts"] = main["volumeMounts"] + conn_mounts
+
+    init_specs = []
+    if run.program is not None:
+        # materialize the compiled program spec into the context volume —
+        # the file the launcher points POLYAXON_PROGRAM_SPEC at
+        import json as _json
+
+        program_payload = _json.dumps(
+            {
+                "runUuid": compiled.run_uuid,
+                "program": run.program.to_dict(),
+                "mesh": run.mesh.axis_sizes() if run.mesh else None,
+            }
+        )
+        init_specs.append(
+            {
+                "name": "polyaxon-program",
+                "image": "busybox:stable",
+                "command": ["sh", "-c"],
+                "args": ['printf "%s" "$POLYAXON_PROGRAM_JSON" > /polyaxon-context/program.json'],
+                "env": [{"name": "POLYAXON_PROGRAM_JSON", "value": program_payload}],
+                "volumeMounts": [CONTEXT_MOUNT],
+            }
+        )
+    for init in getattr(run, "init", None) or ():
+        init_specs.append(
+            init_container(
+                git=init.git,
+                artifacts=init.artifacts,
+                paths=init.paths,
+                connection=init.connection,
+            )
+        )
+
+    labels = {
+        "app.kubernetes.io/managed-by": "polyaxon-tpu",
+        "polyaxon/run-uuid": compiled.run_uuid,
+        **((env.labels or {}) if env else {}),
+    }
+    svc_name = f"{compiled.name}-hosts"
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": svc_name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "clusterIP": "None",  # headless: stable per-pod DNS for rendezvous
+            "selector": {"polyaxon/run-uuid": compiled.run_uuid},
+            "ports": [{"port": coordinator_port, "name": "coordinator"}],
+        },
+    }
+    pod_spec: dict[str, Any] = {
+        "subdomain": svc_name,
+        "restartPolicy": "Never",  # gang restarts are operator-level
+        "containers": [
+            main,
+            sidecar_container(run_uuid=compiled.run_uuid),
+        ],
+        "volumes": volumes,
+        **_pod_scheduling(env, tpu),
+    }
+    if init_specs:
+        pod_spec["initContainers"] = init_specs
+    term = compiled.component.termination
+    job = {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": compiled.name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "completionMode": "Indexed",
+            "completions": n_hosts,
+            "parallelism": n_hosts,
+            "backoffLimit": (term.max_retries if term and term.max_retries else 0),
+            **(
+                {"activeDeadlineSeconds": int(term.timeout)}
+                if term and term.timeout
+                else {}
+            ),
+            "template": {
+                "metadata": {"labels": labels, "annotations": dict(env.annotations or {}) if env else {}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    return [service, job]
+
+
+def _resolve_connections(run, catalog: Optional[ConnectionCatalog]) -> list:
+    names = list(getattr(run, "connections", None) or ())
+    if not names:
+        return []
+    if catalog is None:
+        raise ConversionError(
+            f"run references connections {names} but no catalog is configured"
+        )
+    return [catalog.get(n) for n in names]
+
+
+def convert_job(
+    compiled: CompiledOperation,
+    catalog: Optional[ConnectionCatalog] = None,
+    *,
+    namespace: str = "polyaxon",
+) -> list[dict]:
+    run = compiled.run
+    env = getattr(run, "environment", None)
+    conns = _resolve_connections(run, catalog)
+    volumes, conn_mounts = _volumes(conns)
+    c = run.container
+    if c is None or not (c.command or c.args):
+        raise ConversionError("job kind requires a container command")
+    term = compiled.component.termination
+    labels = {
+        "app.kubernetes.io/managed-by": "polyaxon-tpu",
+        "polyaxon/run-uuid": compiled.run_uuid,
+    }
+    container = {
+        "name": "polyaxon-main",
+        "image": c.image or "busybox",
+        "command": list(c.command or []),
+        "args": list(c.args or []),
+        "env": _run_env(compiled)
+        + [
+            {"name": e["name"], "value": str(e.get("value", ""))}
+            for e in (c.env if isinstance(c.env, list) else [])
+        ]
+        + (
+            [{"name": k, "value": str(v)} for k, v in c.env.items()]
+            if isinstance(c.env, dict)
+            else []
+        ),
+        "volumeMounts": [CONTEXT_MOUNT, ARTIFACTS_MOUNT] + conn_mounts,
+    }
+    return [
+        {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": compiled.name, "namespace": namespace, "labels": labels},
+            "spec": {
+                "backoffLimit": (term.max_retries if term and term.max_retries else 0),
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "restartPolicy": "Never",
+                        "containers": [container, sidecar_container(run_uuid=compiled.run_uuid)],
+                        "volumes": volumes,
+                        **_pod_scheduling(env, None),
+                    },
+                },
+            },
+        }
+    ]
+
+
+def convert_service(
+    compiled: CompiledOperation,
+    catalog: Optional[ConnectionCatalog] = None,
+    *,
+    namespace: str = "polyaxon",
+) -> list[dict]:
+    run = compiled.run
+    env = getattr(run, "environment", None)
+    c = run.container
+    if c is None:
+        raise ConversionError("service kind requires a container")
+    ports = list(getattr(run, "ports", None) or [8000])
+    labels = {
+        "app.kubernetes.io/managed-by": "polyaxon-tpu",
+        "polyaxon/run-uuid": compiled.run_uuid,
+    }
+    conns = _resolve_connections(run, catalog)
+    volumes, conn_mounts = _volumes(conns)
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": compiled.name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": int(getattr(run, "replicas", 1) or 1),
+            "selector": {"matchLabels": {"polyaxon/run-uuid": compiled.run_uuid}},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "polyaxon-main",
+                            "image": c.image,
+                            "command": list(c.command or []),
+                            "args": list(c.args or []),
+                            "env": _run_env(compiled),
+                            "ports": [{"containerPort": p} for p in ports],
+                            "volumeMounts": [CONTEXT_MOUNT, ARTIFACTS_MOUNT]
+                            + conn_mounts,
+                        }
+                    ],
+                    "volumes": volumes,
+                    **_pod_scheduling(env, None),
+                },
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": compiled.name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "selector": {"polyaxon/run-uuid": compiled.run_uuid},
+            "ports": [{"port": p} for p in ports],
+        },
+    }
+    return [deployment, service]
+
+
+def convert_operation(
+    compiled: CompiledOperation,
+    catalog: Optional[ConnectionCatalog] = None,
+    *,
+    namespace: str = "polyaxon",
+) -> list[dict]:
+    kind = compiled.run.kind
+    if kind == "jaxjob":
+        return convert_jaxjob(compiled, catalog, namespace=namespace)
+    if kind == "job":
+        return convert_job(compiled, catalog, namespace=namespace)
+    if kind == "service":
+        return convert_service(compiled, catalog, namespace=namespace)
+    raise ConversionError(f"run kind {kind!r} has no k8s conversion (dag runs walk children)")

@@ -1,0 +1,102 @@
+"""Mixture-of-Experts FFN with expert-axis parallelism.
+
+Reference parity: EP is absent upstream (SURVEY.md §2 parallelism census —
+an obligation for the rebuild). TPU-shaped Switch/GShard design:
+
+- Expert weights carry a leading E dim sharded over the mesh `expert` axis
+  (sharding rules in transformer.py); the dispatch/combine einsums then
+  partition into all-to-alls by GSPMD — no hand-written collectives.
+- Top-1 (switch) routing with capacity factor: static shapes everywhere
+  (one-hot dispatch masks, capacity-clipped cumsum positions), so XLA can
+  tile the expert matmuls on the MXU with no dynamic gather.
+- Router logits/probs in f32; load-balancing aux loss sown into the
+  `losses` collection — the trainer adds every entry there to the loss
+  (ModelBundle.aux_losses).
+- Overflow tokens (beyond capacity) pass through the residual unchanged —
+  the standard switch-transformer behavior.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEFeedForward(nn.Module):
+    dim: int
+    ffn_dim: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    router_noise: float = 0.0
+    aux_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        B, S, D = x.shape
+        E = self.n_experts
+        C = max(1, int(self.capacity_factor * S / E))  # per-group capacity
+
+        router = nn.Dense(E, use_bias=False, name="router")
+        logits = router(x).astype(jnp.float32)  # [B,S,E]
+        if train and self.router_noise > 0:
+            rng = self.make_rng("dropout")
+            logits = logits + self.router_noise * jax.random.normal(
+                rng, logits.shape, jnp.float32
+            )
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [B,S,E]
+        gate = (probs * onehot).sum(-1)  # [B,S] chosen-expert prob
+
+        # load-balancing aux loss (Switch eq. 4): E * Σ_e f_e · p_e
+        density = onehot.mean(axis=(0, 1))          # fraction routed to e
+        density_proxy = probs.mean(axis=(0, 1))     # mean router prob for e
+        aux = E * jnp.sum(density * density_proxy)
+        self.sow("losses", "moe_aux", self.aux_weight * aux)
+
+        # capacity: position of each token within its expert's queue
+        position = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # [B,S,E]
+        keep = (position < C).astype(jnp.float32) * onehot
+        pos_clipped = jnp.minimum(position, C - 1).astype(jnp.int32)
+        # dispatch mask [B,S,E,C]
+        dispatch = keep[..., None] * jax.nn.one_hot(pos_clipped, C, dtype=jnp.float32)
+        combine = dispatch * gate[:, :, None, None]
+
+        # route tokens to expert buffers: [E, B, C, D]
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x)
+
+        # expert FFN (SwiGLU) with stacked weights [E, ...]
+        def ffn(inputs):  # [E,B,C,D]
+            wg = self.param(
+                "gate_kernel",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (E, D, self.ffn_dim),
+            )
+            wu = self.param(
+                "up_kernel",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (E, D, self.ffn_dim),
+            )
+            wd = self.param(
+                "down_kernel",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (E, self.ffn_dim, D),
+            )
+            h = nn.silu(jnp.einsum("ebcd,edf->ebcf", inputs, wg.astype(inputs.dtype)))
+            h = h * jnp.einsum("ebcd,edf->ebcf", inputs, wu.astype(inputs.dtype))
+            return jnp.einsum("ebcf,efd->ebcd", h, wd.astype(inputs.dtype))
+
+        expert_out = ffn(expert_in)
+        # combine back: overflow tokens (empty combine row) get zeros, so the
+        # residual connection outside passes them through unchanged
+        return jnp.einsum("ebcd,bsec->bsd", expert_out, combine.astype(x.dtype))
+
+
+# sharding rules for stacked expert weights: expert dim over `expert` axis,
+# hidden dim over `model` (TP within each expert)
+MOE_RULES = (
+    (r"(gate_kernel|up_kernel)$", ("expert", "fsdp", "model")),
+    (r"down_kernel$", ("expert", "model", "fsdp")),
+    (r"router/kernel", (None, None)),
+)

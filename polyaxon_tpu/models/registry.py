@@ -48,6 +48,9 @@ class ModelBundle:
     trainable_patterns: tuple = ()
     # Extra collections the module carries through apply (e.g. batch_stats).
     mutable: tuple[str, ...] = ()
+    # True if the module sows auxiliary losses into the `losses` collection
+    # (e.g. MoE load balancing); the trainer adds them to the total loss.
+    aux_losses: bool = False
 
 
 def register(name: str):

@@ -53,6 +53,12 @@ class TransformerConfig:
     lora_targets: tuple = ()  # projection names; empty = all projections
     tie_embeddings: bool = False
     scan_layers: bool = False
+    # MoE: replace the dense FFN with n_experts switch-routed experts
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    # pipeline parallelism: stage count (mesh `pipeline` axis size must match)
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -177,7 +183,20 @@ class Block(nn.Module):
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
         x = x + h
-        h = FeedForward(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        if cfg.n_experts > 0:
+            from .moe import MoEFeedForward
+
+            h = MoEFeedForward(
+                cfg.dim,
+                cfg.ffn_dim,
+                cfg.n_experts,
+                capacity_factor=cfg.capacity_factor,
+                name="moe",
+            )(RMSNorm(cfg.norm_eps, name="mlp_norm")(x), train=self.train)
+        else:
+            h = FeedForward(cfg, name="mlp")(
+                RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+            )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
         return x + h
@@ -194,6 +213,65 @@ class _ScanBlock(nn.Module):
         return Block(self.cfg, self.train, name="block")(x), None
 
 
+class PipelinedLayers(nn.Module):
+    """The block stack with stage-stacked params [P, Lp, ...] executed as a
+    GPipe pipeline over the mesh `pipeline` axis (parallel/pipeline.py).
+
+    Params are created functionally (vmapped Block.init) so their tree
+    matches an ordinary per-layer stack with two extra leading dims — the
+    PIPELINE_RULES shardings place dim 0 on the pipeline axis. Without a
+    pipeline mesh axis in scope the same params run as a plain nested scan,
+    so init/dry-run on one device is identical math. Dropout and MoE aux
+    losses are unsupported inside the pipelined stack."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        n_stages = cfg.pipeline_stages
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by "
+                f"pipeline_stages {n_stages}"
+            )
+        per_stage = cfg.n_layers // n_stages
+        block = Block(cfg, False)
+        template = jnp.zeros((1, cfg.seq_len, cfg.dim), x.dtype)
+
+        def init_stacked(rng):
+            def one(r):
+                return block.init({"params": r}, template)["params"]
+
+            stacked = jax.vmap(one)(jax.random.split(rng, cfg.n_layers))
+            return jax.tree.map(
+                lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]), stacked
+            )
+
+        params = self.param("stages", init_stacked)
+
+        def stage_fn(stage_params, h):
+            def layer(carry, layer_params):
+                return block.apply({"params": layer_params}, carry), None
+
+            h, _ = jax.lax.scan(layer, h, stage_params)
+            return h
+
+        from ..parallel.ring import current_mesh
+
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("pipeline", 1) > 1:
+            from ..parallel.pipeline import pipeline_apply
+
+            n_micro = cfg.pipeline_microbatches or n_stages
+            return pipeline_apply(
+                stage_fn, params, x, mesh=mesh, n_micro=n_micro
+            )
+        # no pipeline axis (init, dry-run, single device): same math, nested scan
+        h, _ = jax.lax.scan(lambda c, p: (stage_fn(p, c), None), x, params)
+        return h
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
@@ -207,10 +285,12 @@ class Transformer(nn.Module):
             embedding_init=nn.initializers.normal(0.02),
         )
         x = embed(tokens)
-        if cfg.scan_layers:
+        if cfg.pipeline_stages > 1:
+            x = PipelinedLayers(cfg, name="pipeline")(x)
+        elif cfg.scan_layers:
             Layers = nn.scan(
                 _ScanBlock,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
             )
@@ -245,6 +325,15 @@ TRANSFORMER_RULES = (
 SCAN_RULES = tuple(
     (pat, (None, *axes)) if "embedding" not in pat and "lm_head" not in pat else (pat, axes)
     for pat, axes in TRANSFORMER_RULES
+)
+
+# Pipelined stack: kernels are [stages, layers_per_stage, in, out] under
+# `pipeline/stages/...` — stage dim on the pipeline axis. Listed before the
+# base rules so the anchored prefix wins the first-match resolution.
+PIPELINE_RULES = tuple(
+    (r"stages/.*" + pat, ("pipeline", None, *axes))
+    for pat, axes in TRANSFORMER_RULES
+    if "embedding" not in pat and "lm_head" not in pat
 )
 
 PRESETS: dict[str, dict] = {
@@ -284,7 +373,20 @@ def _make_config(config: dict) -> TransformerConfig:
     base: dict = dict(PRESETS.get(preset, {}))
     base.update({k: v for k, v in config.items() if v is not None})
     fields = {f.name for f in dataclasses.fields(TransformerConfig)}
-    return TransformerConfig(**{k: v for k, v in base.items() if k in fields})
+    cfg = TransformerConfig(**{k: v for k, v in base.items() if k in fields})
+    if cfg.pipeline_stages > 1:
+        # the pipelined stack applies blocks functionally: no dropout rngs,
+        # no mutable collections — reject rather than silently change the
+        # training objective
+        if cfg.dropout_rate > 0:
+            raise ValueError("pipeline_stages > 1 does not support dropout_rate > 0")
+        if cfg.n_experts > 0:
+            raise ValueError(
+                "pipeline_stages > 1 does not support MoE (n_experts > 0): "
+                "the load-balancing aux loss cannot be sown through the "
+                "pipelined stack"
+            )
+    return cfg
 
 
 @register("transformer_lm")
@@ -292,14 +394,27 @@ def build_transformer(config: dict) -> ModelBundle:
     cfg = _make_config(config)
     module = Transformer(cfg)
     trainable = (r"lora_[ab]$",) if cfg.lora_rank > 0 else ()
+    rules = SCAN_RULES if cfg.scan_layers else TRANSFORMER_RULES
+    if cfg.pipeline_stages > 1:
+        rules = PIPELINE_RULES + TRANSFORMER_RULES
+    if cfg.n_experts > 0:
+        from .moe import MOE_RULES
+
+        moe_rules = (
+            tuple((pat, (None, *axes)) for pat, axes in MOE_RULES)
+            if cfg.scan_layers
+            else MOE_RULES
+        )
+        rules = moe_rules + rules
     return ModelBundle(
         name="transformer_lm",
         module=module,
         example_inputs=i32_tokens(cfg.seq_len),
         loss="masked_lm",
-        sharding_rules=SCAN_RULES if cfg.scan_layers else TRANSFORMER_RULES,
+        sharding_rules=rules,
         task="lm",
         trainable_patterns=trainable,
+        aux_losses=cfg.n_experts > 0,
     )
 
 

@@ -26,25 +26,31 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 from .mesh import BATCH_AXES
 
 NEG_INF = -1e30
 
 # Mesh currently in scope for model-internal collectives (ring attention,
-# MoE all-to-all). The trainer sets this before tracing; a context var
-# rather than a module argument keeps model code mesh-agnostic.
-_CURRENT_MESH: Optional[Mesh] = None
+# pipelined layers). The trainer sets this before tracing; a context var
+# rather than a module argument keeps model code mesh-agnostic. Thread-local
+# because the sweep driver traces concurrent trials, each on its own
+# device sub-slice — a shared global would cross-wire their meshes.
+import threading as _threading
+
+_MESH_STATE = _threading.local()
 
 
 def set_current_mesh(mesh: Optional[Mesh]) -> None:
-    global _CURRENT_MESH
-    _CURRENT_MESH = mesh
+    _MESH_STATE.mesh = mesh
 
 
 def current_mesh() -> Optional[Mesh]:
-    return _CURRENT_MESH
+    return getattr(_MESH_STATE, "mesh", None)
 
 
 def _chunk_attention(q, k, v, scale, full, same):

@@ -53,7 +53,9 @@ class Executor:
 
         for s in (V1Statuses.COMPILED, V1Statuses.QUEUED, V1Statuses.SCHEDULED):
             current = V1Statuses(store.get_status(run_uuid)["status"])
-            if can_transition(current, s):
+            # strict inequality: don't append duplicate conditions for the
+            # stage an agent-submitted run is already in
+            if current != s and can_transition(current, s):
                 store.set_status(run_uuid, s)
 
         term = compiled.component.termination
@@ -108,13 +110,6 @@ class Executor:
         store, run_uuid = self.store, compiled.run_uuid
         mesh_axes = run.mesh.axis_sizes() if run.mesh else None
 
-        def log_fn(step: int, metrics: dict):
-            store.log_metrics(run_uuid, step, metrics)
-            line = f"step {step}: " + " ".join(
-                f"{k}={v:.6g}" for k, v in metrics.items()
-            )
-            store.append_log(run_uuid, line)
-
         ckpt_dir = None
         tspec = run.program.train
         if tspec and (tspec.checkpoint_every or tspec.resume):
@@ -127,12 +122,27 @@ class Executor:
             program = program.model_copy(
                 update={"train": tspec.model_copy(update={"resume": True})}
             )
+
+        replicas = int(getattr(run, "replicas", 1) or 1)
+        if replicas > 1:
+            # resume/ckpt handling above is shared: workers receive the
+            # already-resumed program and the same checkpoint dir
+            return self._run_distributed(compiled, replicas, program, ckpt_dir)
+
+        def log_fn(step: int, metrics: dict):
+            store.log_metrics(run_uuid, step, metrics)
+            line = f"step {step}: " + " ".join(
+                f"{k}={v:.6g}" for k, v in metrics.items()
+            )
+            store.append_log(run_uuid, line)
+
         trainer = Trainer(
             program,
             mesh_axes=mesh_axes,
             devices=self.devices,
             log_fn=log_fn,
             checkpoint_dir=ckpt_dir,
+            artifacts_dir=str(store.outputs_dir(run_uuid)),
         )
         store.set_status(run_uuid, V1Statuses.RUNNING)
         result = trainer.run()
@@ -149,6 +159,61 @@ class Executor:
             f"done: {result.steps_per_sec:.2f} steps/s, "
             f"final {result.final_metrics}",
         )
+
+    def _run_distributed(
+        self, compiled: CompiledOperation, replicas: int, program, ckpt_dir
+    ):
+        """Multi-process gang via the native C++ supervisor: each worker is
+        a `runtime.worker` process; rendezvous env is injected by the
+        launcher; gang semantics restart all-or-nothing. On real multi-host
+        TPU the k8s converter schedules one such gang per host; locally the
+        gang runs on this host (multi-process jax.distributed over CPU)."""
+        import json as _json
+        import tempfile
+
+        from ..native import launcher_path, pick_port
+
+        run = compiled.run
+        store, run_uuid = self.store, compiled.run_uuid
+        payload = {
+            "runUuid": run_uuid,
+            "program": program.to_dict(),
+            "mesh": run.mesh.axis_sizes() if run.mesh else None,
+        }
+        if ckpt_dir is not None:
+            payload["checkpointDir"] = ckpt_dir
+        spec_file = tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        )
+        _json.dump(payload, spec_file)
+        spec_file.close()
+        term = compiled.component.termination
+        cmd = [
+            launcher_path(),
+            "--num-workers", str(replicas),
+            "--coordinator", f"127.0.0.1:{pick_port(run_uuid)}",
+            "--max-restarts", "0",  # retries handled by execute()'s loop
+            *(
+                ["--timeout", str(int(term.timeout))]
+                if term and term.timeout
+                else []
+            ),
+            "--env", f"POLYAXON_PROGRAM_SPEC={spec_file.name}",
+            "--env", f"POLYAXON_HOME={store.home}",
+            "--", sys.executable, "-m", "polyaxon_tpu.runtime.worker",
+        ]
+        store.set_status(run_uuid, V1Statuses.RUNNING)
+        try:
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+            )
+            for line in iter(proc.stdout.readline, ""):
+                store.append_log(run_uuid, "[launcher] " + line.rstrip("\n"))
+            code = proc.wait()
+        finally:
+            os.unlink(spec_file.name)
+        if code != 0:
+            raise ExecutionError(f"distributed gang exited with code {code}")
 
     def _run_container(self, compiled: CompiledOperation, timeout=None):
         """Local-subprocess stand-in for the k8s pod path: runs the container

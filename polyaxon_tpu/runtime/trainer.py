@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from pathlib import Path
 from typing import Any, Callable, Optional
 
 import jax
@@ -86,7 +87,9 @@ class Trainer:
         devices: Optional[list] = None,
         log_fn: Optional[Callable[[int, dict], None]] = None,
         checkpoint_dir: Optional[str] = None,
+        artifacts_dir: Optional[str] = None,
     ):
+        self.artifacts_dir = artifacts_dir
         self.program = program
         tspec = program.train
         if tspec is None:
@@ -206,14 +209,26 @@ class Trainer:
         is_classification = bundle.task == "classification"
         seed = int(tspec.seed)
 
+        collections = list(mutable) + (["losses"] if bundle.aux_losses else [])
+
         def apply(params, extra, inputs, rng):
             rngs = {k: jax.random.fold_in(rng, i) for i, k in enumerate(bundle.rngs)}
             variables = {"params": params, **extra}
-            if mutable:
-                return bundle.module.apply(
-                    variables, inputs, train=True, rngs=rngs, mutable=list(mutable)
+            if not collections:
+                logits = bundle.module.apply(
+                    variables, inputs, train=True, rngs=rngs
                 )
-            return bundle.module.apply(variables, inputs, train=True, rngs=rngs), {}
+                return logits, {}, jnp.zeros((), jnp.float32)
+            logits, updates = bundle.module.apply(
+                variables, inputs, train=True, rngs=rngs, mutable=collections
+            )
+            updates = dict(updates)
+            sown = updates.pop("losses", {})
+            aux = sum(
+                (jnp.sum(jnp.asarray(v)) for v in jax.tree.leaves(sown)),
+                jnp.zeros((), jnp.float32),
+            )
+            return logits, updates, aux
 
         if use_remat:
             apply = jax.checkpoint(apply)
@@ -232,8 +247,8 @@ class Trainer:
                 inputs = batch["inputs"]
                 if jnp.issubdtype(inputs.dtype, jnp.floating):
                     inputs = inputs.astype(compute_dtype)
-                logits, new_extra = apply(compute_params, state.extra, inputs, rng)
-                return loss_fn(logits, batch), (logits, new_extra)
+                logits, new_extra, aux = apply(compute_params, state.extra, inputs, rng)
+                return loss_fn(logits, batch) + aux, (logits, new_extra)
 
             (loss, (logits, new_extra)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
@@ -267,6 +282,28 @@ class Trainer:
             donate_argnums=donate,
         )
 
+        def eval_fn(state: TrainState, batch):
+            params = (
+                _cast_floats(state.params, compute_dtype)
+                if compute_dtype != param_dtype
+                else state.params
+            )
+            inputs = batch["inputs"]
+            if jnp.issubdtype(inputs.dtype, jnp.floating):
+                inputs = inputs.astype(compute_dtype)
+            variables = {"params": params, **state.extra}
+            logits = bundle.module.apply(variables, inputs, train=False)
+            metrics = {"eval.loss": loss_fn(logits, batch).astype(jnp.float32)}
+            if is_classification:
+                metrics["eval.accuracy"] = accuracy_metric(logits, batch)
+            return metrics
+
+        self.eval_step = jax.jit(
+            eval_fn,
+            in_shardings=(state_shardings, self.b_shard),
+            out_shardings=rep,
+        )
+
     # -------------------------------------------------------------- loop
     def run(self) -> TrainResult:
         from ..parallel.ring import set_current_mesh
@@ -282,18 +319,63 @@ class Trainer:
         it = self.data.iterator
         metrics = {}
         pending: Optional[tuple[int, dict]] = None
+
+        # prefetch: host batch prep + device_put run on a producer thread,
+        # overlapping the device step — keeps the input pipeline off the
+        # critical path (host-side generation was 14x the step time on v5e)
+        import queue as _queue
+        import threading as _threading
+
+        n_steps = self.steps - start_step
+        feed: _queue.Queue = _queue.Queue(maxsize=2)
+
+        def _produce():
+            try:
+                for _ in range(n_steps):
+                    feed.put(make_global_batch(next(it), self.mesh, self.b_shard))
+            except BaseException as e:  # noqa: BLE001 — surface in consumer
+                feed.put(e)
+
+        producer = _threading.Thread(target=_produce, daemon=True)
+        producer.start()
+
+        eval_every = int(tspec.eval_every) if tspec.eval_every else 0
+        eval_steps = int(tspec.eval_steps) if tspec.eval_steps else 4
+        prof_start = (
+            int(tspec.profile_start) if tspec.profile_start is not None else None
+        )
+        prof_stop = int(tspec.profile_stop) if tspec.profile_stop is not None else None
+        profiling = False
+
         t0 = time.perf_counter()
         for step in range(start_step, self.steps):
-            batch = make_global_batch(next(it), self.mesh, self.b_shard)
+            if prof_start is not None and step == prof_start and self.artifacts_dir:
+                jax.profiler.start_trace(str(Path(self.artifacts_dir) / "profile"))
+                profiling = True
+            batch = feed.get()
+            if isinstance(batch, BaseException):
+                raise batch
             self.state, metrics = self.train_step(self.state, batch)
+            if profiling and prof_stop is not None and step + 1 >= prof_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
             if (step + 1) % log_every == 0 or step + 1 == self.steps:
                 # flush the previous log point first: keeps one step of
                 # pipelining so logging never stalls the device queue
                 if pending is not None:
                     self._emit(history, *pending)
                 pending = (step + 1, metrics)
+            if eval_every and ((step + 1) % eval_every == 0 or step + 1 == self.steps):
+                eval_metrics = self._evaluate(eval_steps)
+                if pending is not None:
+                    self._emit(history, *pending)
+                    pending = None
+                self._emit(history, step + 1, eval_metrics)
             if ckpt_every and (step + 1) % ckpt_every == 0:
                 self.save(step + 1)
+        if profiling:
+            jax.profiler.stop_trace()
         if pending is not None:
             self._emit(history, *pending)
         elapsed = time.perf_counter() - t0
@@ -307,6 +389,32 @@ class Trainer:
         return TrainResult(
             state=self.state, history=history, steps_per_sec=sps, final_metrics=final
         )
+
+    def _evaluate(self, eval_steps: int) -> dict:
+        """Average eval metrics over `eval_steps` batches from a dedicated
+        eval stream (own iterator: the training iterator is owned by the
+        prefetch thread, and a distinct seed gives held-out data)."""
+        if not hasattr(self, "_eval_data"):
+            dspec = self.program.data
+            # same seed (the synthetic task — prototypes/chain — must match
+            # training); the shifted process_index decorrelates the sample
+            # stream so eval batches differ from training batches
+            self._eval_data = build_data(
+                dspec.name if dspec else "synthetic",
+                self.data.batch_size * jax.process_count(),
+                dspec.config if dspec else None,
+                seed=int(self.tspec.seed),
+                process_index=jax.process_index() + 7919 * jax.process_count(),
+                process_count=jax.process_count(),
+            )
+        totals: dict[str, float] = {}
+        it = self._eval_data.iterator
+        for _ in range(eval_steps):
+            batch = make_global_batch(next(it), self.mesh, self.b_shard)
+            m = self.eval_step(self.state, batch)
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+        return {k: v / eval_steps for k, v in totals.items()}
 
     def _emit(self, history, step, metrics):
         vals = {k: float(v) for k, v in metrics.items()}

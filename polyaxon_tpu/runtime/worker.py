@@ -1,0 +1,82 @@
+"""Distributed JAXJob worker: one process of the gang.
+
+Launched by the native supervisor (native/launcher.cpp), which injects the
+rendezvous env (JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID /
+JAX_NUM_PROCESSES) — the TPU-native replacement for the reference's
+TF_CONFIG / MASTER_ADDR wiring (SURVEY.md §5 comm backend). Every process
+runs the same program (SPMD); jax.distributed.initialize makes all hosts'
+devices one global mesh, and XLA routes collectives over ICI/DCN.
+
+Process 0 is the only writer: metrics/logs/summary go to the run store the
+coordinator shares with the supervisor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    coord = os.environ["JAX_COORDINATOR_ADDRESS"]
+    process_id = int(os.environ["JAX_PROCESS_ID"])
+    num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    spec_path = os.environ["POLYAXON_PROGRAM_SPEC"]
+
+    import jax
+
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    with open(spec_path) as f:
+        payload = json.load(f)
+
+    from ..schemas.run_kinds import V1Program
+    from .trainer import Trainer
+
+    program = V1Program.model_validate(payload["program"])
+    run_uuid = payload["runUuid"]
+    is_chief = process_id == 0
+
+    store = None
+    log_fn = None
+    if is_chief:
+        from ..store.local import RunStore
+
+        store = RunStore()
+
+        def log_fn(step: int, metrics: dict):
+            store.log_metrics(run_uuid, step, metrics)
+            line = f"step {step}: " + " ".join(
+                f"{k}={v:.6g}" for k, v in metrics.items()
+            )
+            store.append_log(run_uuid, line)
+
+    trainer = Trainer(
+        program,
+        mesh_axes=payload.get("mesh"),
+        log_fn=log_fn,
+        # all processes participate in (multi-host) checkpointing
+        checkpoint_dir=payload.get("checkpointDir"),
+    )
+    result = trainer.run()
+    if is_chief and store is not None:
+        store.log_event(
+            run_uuid,
+            "run_summary",
+            {
+                "steps_per_sec": result.steps_per_sec,
+                "final_metrics": result.final_metrics,
+                "num_processes": num_processes,
+            },
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

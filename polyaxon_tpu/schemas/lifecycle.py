@@ -71,7 +71,7 @@ TRANSITIONS: dict[V1Statuses, frozenset[V1Statuses]] = {
         {V1Statuses.STARTING, V1Statuses.RUNNING, V1Statuses.FAILED, V1Statuses.STOPPED, V1Statuses.UNSCHEDULABLE, V1Statuses.UNKNOWN}
     ),
     V1Statuses.STARTING: frozenset(
-        {V1Statuses.RUNNING, V1Statuses.FAILED, V1Statuses.STOPPED, V1Statuses.UNKNOWN}
+        {V1Statuses.RUNNING, V1Statuses.FAILED, V1Statuses.STOPPED, V1Statuses.UNKNOWN, V1Statuses.RETRYING}
     ),
     V1Statuses.RUNNING: frozenset(
         {V1Statuses.PROCESSING, V1Statuses.SUCCEEDED, V1Statuses.FAILED, V1Statuses.STOPPING, V1Statuses.STOPPED, V1Statuses.WARNING, V1Statuses.UNKNOWN, V1Statuses.RETRYING}

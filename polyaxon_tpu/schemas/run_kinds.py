@@ -77,6 +77,10 @@ class V1TrainSpec(BaseSchema):
     steps: int | str = 100
     eval_every: Optional[int | str] = None
     eval_steps: Optional[int | str] = None
+    # jax.profiler capture window [start_step, end_step); the trace lands in
+    # the run's outputs dir as a TensorBoard/Perfetto artifact (SURVEY.md §5)
+    profile_start: Optional[int | str] = None
+    profile_stop: Optional[int | str] = None
     log_every: int | str = 10
     checkpoint_every: Optional[int | str] = None
     resume: Optional[bool] = None

@@ -1,0 +1,3 @@
+"""Streams service: HTTP log/metric/event/artifact access (SURVEY.md §2)."""
+
+from .server import BackgroundServer, make_server, serve  # noqa: F401

@@ -1,0 +1,151 @@
+"""Streams service: HTTP access to run logs/metrics/events/artifacts.
+
+Reference parity (SURVEY.md §2 "Streams": an ASGI service tailing fs/k8s).
+Local rebuild: a dependency-free ThreadingHTTPServer over the run store —
+the same files the trainer/sidecar write. Endpoints:
+
+  GET /healthz
+  GET /runs                         → index (optionally ?project=)
+  GET /runs/<uuid>/status
+  GET /runs/<uuid>/logs[?offset=N]  → text; offset supports tail-follow
+  GET /runs/<uuid>/metrics
+  GET /runs/<uuid>/events
+  GET /runs/<uuid>/artifacts        → list outputs tree
+  GET /runs/<uuid>/artifacts/<path> → file download
+
+`polyaxon streams start [--port P]` serves; the CLI's `ops logs --follow`
+polls the offset endpoint the same way upstream's CLI tails the stream ws.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..store.local import RunStore
+
+
+def _json_bytes(data) -> bytes:
+    return json.dumps(data, default=str).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: RunStore  # injected by make_server
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _not_found(self, what: str):
+        self._send(404, _json_bytes({"error": f"{what} not found"}))
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        store = self.store
+        try:
+            if parts == ["healthz"]:
+                return self._send(200, _json_bytes({"status": "ok"}))
+            if parts == ["runs"]:
+                return self._send(
+                    200, _json_bytes(store.list_runs(query.get("project")))
+                )
+            if len(parts) >= 2 and parts[0] == "runs":
+                uuid = store.resolve(parts[1])
+                if not (store.run_dir(uuid) / "status.json").exists():
+                    return self._not_found(f"run {parts[1]}")
+                sub = parts[2] if len(parts) > 2 else "status"
+                if sub == "status":
+                    return self._send(200, _json_bytes(store.get_status(uuid)))
+                if sub == "logs":
+                    text = store.read_logs(uuid)
+                    offset = int(query.get("offset", 0))
+                    chunk = text[offset:]
+                    body = _json_bytes(
+                        {"logs": chunk, "offset": offset + len(chunk)}
+                    )
+                    return self._send(200, body)
+                if sub == "metrics":
+                    return self._send(200, _json_bytes(store.read_metrics(uuid)))
+                if sub == "events":
+                    return self._send(200, _json_bytes(store.read_events(uuid)))
+                if sub == "spec":
+                    return self._send(200, _json_bytes(store.read_spec(uuid)))
+                if sub == "artifacts":
+                    root = store.outputs_dir(uuid)
+                    rel = "/".join(parts[3:])
+                    if rel:
+                        target = (root / rel).resolve()
+                        root_resolved = root.resolve()
+                        # component-wise containment (startswith would let
+                        # a sibling like outputsXYZ through)
+                        if (
+                            target != root_resolved
+                            and root_resolved not in target.parents
+                        ):
+                            return self._send(
+                                403, _json_bytes({"error": "path escapes outputs"})
+                            )
+                        if not target.is_file():
+                            return self._not_found(rel)
+                        return self._send(
+                            200, target.read_bytes(), "application/octet-stream"
+                        )
+                    listing = [
+                        str(p.relative_to(root))
+                        for p in sorted(root.rglob("*"))
+                        if p.is_file()
+                    ]
+                    return self._send(200, _json_bytes({"files": listing}))
+            self._not_found(parsed.path)
+        except KeyError as e:
+            self._not_found(str(e))
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            self._send(500, _json_bytes({"error": str(e)}))
+
+
+def make_server(
+    store: Optional[RunStore] = None, host: str = "127.0.0.1", port: int = 8585
+) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"store": store or RunStore()})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(
+    store: Optional[RunStore] = None, host: str = "127.0.0.1", port: int = 8585
+):
+    server = make_server(store, host, port)
+    print(f"polyaxon streams serving on http://{host}:{port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+class BackgroundServer:
+    """Test/embedding helper: serve on a free port in a daemon thread."""
+
+    def __init__(self, store: Optional[RunStore] = None):
+        self.server = make_server(store, port=0)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()

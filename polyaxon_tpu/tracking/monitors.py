@@ -1,0 +1,117 @@
+"""System monitors: psutil host metrics + TPU device metrics.
+
+Reference parity (SURVEY.md §2 "Traceml" — psutil/NVML monitors). The NVML
+side becomes TPU device stats read from JAX (per-device HBM usage via
+`memory_stats()`); host stats stay psutil. A daemon thread samples every
+`interval` seconds and writes `sys.*` metrics to the run store, where the
+CLI/streams surface them alongside training metrics."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..store.local import RunStore
+
+
+def host_metrics() -> dict[str, float]:
+    import psutil
+
+    vm = psutil.virtual_memory()
+    out = {
+        "sys.cpu_percent": float(psutil.cpu_percent(interval=None)),
+        "sys.memory_percent": float(vm.percent),
+        "sys.memory_used_gb": vm.used / 1e9,
+    }
+    try:
+        disk = psutil.disk_usage("/")
+        out["sys.disk_percent"] = float(disk.percent)
+    except OSError:
+        pass
+    try:
+        la1, _, _ = psutil.getloadavg()
+        out["sys.load1"] = float(la1)
+    except OSError:
+        pass
+    return out
+
+
+def device_metrics() -> dict[str, float]:
+    """Per-accelerator HBM stats from JAX (the TPU stand-in for NVML)."""
+    out: dict[str, float] = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                continue
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if in_use is not None:
+                out[f"sys.tpu{d.id}.hbm_used_gb"] = in_use / 1e9
+            if in_use is not None and limit:
+                out[f"sys.tpu{d.id}.hbm_percent"] = 100.0 * in_use / limit
+    except Exception:
+        pass
+    return out
+
+
+class SystemMonitor:
+    """Background sampler: `with SystemMonitor(store, run_uuid): ...` or
+    explicit start()/stop(). Failures inside the loop never propagate into
+    training."""
+
+    def __init__(
+        self,
+        store: Optional[RunStore] = None,
+        run_uuid: Optional[str] = None,
+        interval: float = 10.0,
+        include_devices: bool = True,
+    ):
+        import os
+
+        self.store = store or RunStore()
+        self.run_uuid = run_uuid or os.environ.get("POLYAXON_RUN_UUID")
+        if self.run_uuid is None:
+            raise ValueError("SystemMonitor needs a run uuid")
+        self.interval = interval
+        self.include_devices = include_devices
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._samples = 0
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                metrics = host_metrics()
+                if self.include_devices:
+                    metrics.update(device_metrics())
+                self.store.log_metrics(self.run_uuid, self._samples, metrics)
+                self._samples += 1
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def start(self) -> "SystemMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="polyaxon-sysmon"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,185 @@
+"""K8s converter golden tests — render manifests for each run kind and
+assert structure, exactly the reference's no-cluster multi-node test
+strategy (SURVEY.md §4: assert the rendered job has N replicas and the
+right env, not that training runs)."""
+
+import pytest
+import yaml
+
+from polyaxon_tpu.compiler.resolver import compile_operation
+from polyaxon_tpu.connections.schemas import ConnectionCatalog
+from polyaxon_tpu.k8s import ConversionError, convert_operation
+from polyaxon_tpu.polyaxonfile.reader import read_polyaxonfile
+
+
+def _compile(tmp_path, spec, params=None):
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    return compile_operation(read_polyaxonfile(str(p), params=params))
+
+
+JAXJOB_SPEC = {
+    "version": 1.1,
+    "kind": "operation",
+    "name": "bert-pretrain",
+    "component": {
+        "kind": "component",
+        "name": "bert",
+        "run": {
+            "kind": "jaxjob",
+            "replicas": 8,
+            "mesh": {"data": -1},
+            "program": {
+                "model": {"name": "bert", "config": {"preset": "tiny-test"}},
+                "data": {"name": "synthetic_mlm", "batchSize": 32},
+                "train": {"steps": 10},
+            },
+            "environment": {
+                "resources": {"tpu": {"type": "v5e", "topology": "4x8"}},
+                "labels": {"team": "ml"},
+            },
+        },
+        "termination": {"maxRetries": 2, "timeout": 3600},
+    },
+}
+
+
+def test_jaxjob_renders_tpu_topology(tmp_path, tmp_home):
+    compiled = _compile(tmp_path, JAXJOB_SPEC)
+    service, job = convert_operation(compiled)
+
+    assert service["kind"] == "Service"
+    assert service["spec"]["clusterIP"] == "None"  # headless rendezvous
+
+    assert job["kind"] == "Job"
+    spec = job["spec"]
+    # v5e 4x8 = 32 chips / 4 per host = 8 indexed pods
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == 8
+    assert spec["parallelism"] == 8
+    assert spec["backoffLimit"] == 2
+    assert spec["activeDeadlineSeconds"] == 3600
+
+    pod = spec["template"]["spec"]
+    sel = pod["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x8"
+
+    main = pod["containers"][0]
+    assert main["resources"]["limits"]["google.com/tpu"] == "4"
+    env = {e["name"]: e for e in main["env"]}
+    assert env["JAX_NUM_PROCESSES"]["value"] == "8"
+    assert "job-completion-index" in str(env["JOB_COMPLETION_INDEX"]["valueFrom"])
+    assert env["POLYAXON_RUN_UUID"]["value"] == compiled.run_uuid
+    # gang launcher drives the worker, deriving each worker's global rank
+    # from the pod's completion index
+    assert main["command"] == ["polyaxon-launcher"]
+    assert "--process-id-offset" in main["args"]
+    assert main["args"][main["args"].index("--total-processes") + 1] == "8"
+
+    names = [c["name"] for c in pod["containers"]]
+    assert "polyaxon-sidecar" in names
+    assert job["metadata"]["labels"]["team"] == "ml"
+
+
+def test_job_kind_renders_batch_job(tmp_path, tmp_home):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "prep",
+        "component": {
+            "kind": "component",
+            "name": "prep",
+            "run": {
+                "kind": "job",
+                "container": {
+                    "image": "python:3.11",
+                    "command": ["python", "prep.py"],
+                    "env": {"MODE": "full"},
+                },
+            },
+        },
+    }
+    compiled = _compile(tmp_path, spec)
+    (job,) = convert_operation(compiled)
+    main = job["spec"]["template"]["spec"]["containers"][0]
+    assert main["image"] == "python:3.11"
+    assert main["command"] == ["python", "prep.py"]
+    assert {"name": "MODE", "value": "full"} in main["env"]
+    assert "nodeSelector" not in job["spec"]["template"]["spec"]
+
+
+def test_service_kind_renders_deployment_and_service(tmp_path, tmp_home):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "tboard",
+        "component": {
+            "kind": "component",
+            "name": "tboard",
+            "run": {
+                "kind": "service",
+                "replicas": 2,
+                "ports": [6006],
+                "container": {
+                    "image": "tensorflow/tensorflow",
+                    "command": ["tensorboard"],
+                },
+            },
+        },
+    }
+    compiled = _compile(tmp_path, spec)
+    deployment, service = convert_operation(compiled)
+    assert deployment["kind"] == "Deployment"
+    assert deployment["spec"]["replicas"] == 2
+    assert service["spec"]["ports"] == [{"port": 6006}]
+
+
+def test_connections_mount(tmp_path, tmp_home):
+    spec = yaml.safe_load(yaml.safe_dump(JAXJOB_SPEC))
+    spec["component"]["run"]["connections"] = ["datasets"]
+    compiled = _compile(tmp_path, spec)
+    catalog = ConnectionCatalog.from_config(
+        [
+            {
+                "name": "datasets",
+                "spec": {
+                    "kind": "host_path",
+                    "hostPath": "/mnt/data",
+                    "mountPath": "/data",
+                    "readOnly": True,
+                },
+            }
+        ]
+    )
+    _, job = convert_operation(compiled, catalog)
+    pod = job["spec"]["template"]["spec"]
+    vols = {v["name"]: v for v in pod["volumes"]}
+    assert vols["conn-datasets"]["hostPath"]["path"] == "/mnt/data"
+    mounts = {m["name"]: m for m in pod["containers"][0]["volumeMounts"]}
+    assert mounts["conn-datasets"]["mountPath"] == "/data"
+    assert mounts["conn-datasets"]["readOnly"] is True
+
+
+def test_unknown_connection_raises(tmp_path, tmp_home):
+    spec = yaml.safe_load(yaml.safe_dump(JAXJOB_SPEC))
+    spec["component"]["run"]["connections"] = ["ghost"]
+    compiled = _compile(tmp_path, spec)
+    with pytest.raises((ConversionError, KeyError)):
+        convert_operation(compiled, ConnectionCatalog())
+
+
+def test_dag_kind_not_convertible(tmp_path, tmp_home):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "d",
+        "component": {
+            "kind": "component",
+            "name": "d",
+            "run": {"kind": "dag", "operations": []},
+        },
+    }
+    compiled = _compile(tmp_path, spec)
+    with pytest.raises(ConversionError):
+        convert_operation(compiled)

@@ -1,0 +1,107 @@
+"""Streams HTTP service + system monitors + tracking client round trip."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from polyaxon_tpu.store.local import RunStore
+from polyaxon_tpu.streams import BackgroundServer
+from polyaxon_tpu.tracking.monitors import SystemMonitor, host_metrics
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, json.loads(r.read())
+
+
+def _seed_run(store, uuid="abc123def456"):
+    store.create_run(uuid, "seeded", "default", {"kind": "test"})
+    store.log_metrics(uuid, 1, {"loss": 0.5})
+    store.log_metrics(uuid, 2, {"loss": 0.25})
+    store.log_event(uuid, "run_summary", {"final_metrics": {"loss": 0.25}})
+    store.append_log(uuid, "hello line 1")
+    store.append_log(uuid, "hello line 2")
+    (store.outputs_dir(uuid) / "model.txt").write_text("weights")
+    return uuid
+
+
+def test_streams_endpoints(tmp_home):
+    store = RunStore()
+    uuid = _seed_run(store)
+    with BackgroundServer(store) as srv:
+        code, health = _get(srv.port, "/healthz")
+        assert code == 200 and health["status"] == "ok"
+
+        code, runs = _get(srv.port, "/runs")
+        assert code == 200 and runs[0]["uuid"] == uuid
+
+        code, status = _get(srv.port, f"/runs/{uuid}/status")
+        assert status["status"] == "created"
+
+        code, metrics = _get(srv.port, f"/runs/{uuid}/metrics")
+        assert [m["loss"] for m in metrics] == [0.5, 0.25]
+
+        code, logs = _get(srv.port, f"/runs/{uuid}/logs")
+        assert "hello line 1" in logs["logs"]
+        offset = logs["offset"]
+        store.append_log(uuid, "follow me")
+        code, more = _get(srv.port, f"/runs/{uuid}/logs?offset={offset}")
+        assert more["logs"].strip() == "follow me"  # tail-follow semantics
+
+        code, artifacts = _get(srv.port, f"/runs/{uuid}/artifacts")
+        assert artifacts["files"] == ["model.txt"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/runs/{uuid}/artifacts/model.txt"
+        ) as r:
+            assert r.read() == b"weights"
+
+        # short-uuid resolution like the CLI
+        code, status = _get(srv.port, f"/runs/{uuid[:8]}/status")
+        assert code == 200
+
+
+def test_streams_404_and_traversal_guard(tmp_home):
+    store = RunStore()
+    uuid = _seed_run(store)
+    with BackgroundServer(store) as srv:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/runs/{uuid}/artifacts/../status.json"
+            )
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code in (403, 404)
+        assert raised
+
+
+def test_host_metrics_present():
+    m = host_metrics()
+    assert "sys.cpu_percent" in m and "sys.memory_percent" in m
+    assert all(np.isfinite(v) for v in m.values())
+
+
+def test_system_monitor_writes_to_store(tmp_home):
+    store = RunStore()
+    uuid = _seed_run(store, uuid="feedbeefcafe")
+    with SystemMonitor(store, uuid, interval=0.2, include_devices=False):
+        time.sleep(0.7)
+    sys_metrics = [
+        m for m in store.read_metrics(uuid) if "sys.cpu_percent" in m
+    ]
+    assert len(sys_metrics) >= 2
+
+
+def test_tracking_client_roundtrip(tmp_home, monkeypatch):
+    from polyaxon_tpu import tracking
+
+    monkeypatch.delenv("POLYAXON_RUN_UUID", raising=False)
+    run = tracking.init(name="standalone")
+    run.log_metrics(step=1, loss=1.0)
+    run.log_metrics(step=2, loss=0.5)
+    run.log_outputs(final_loss=0.5)
+    run.end()
+    store = RunStore()
+    assert store.get_status(run.uuid)["status"] == "succeeded"
+    assert [m["loss"] for m in store.read_metrics(run.uuid)] == [1.0, 0.5]
