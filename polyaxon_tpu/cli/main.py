@@ -61,6 +61,19 @@ def run(fpath, params, name, project, watch):
     if name:
         op = op.model_copy(update={"name": name})
     store = RunStore()
+    if op.schedule is not None:
+        from ..scheduler import ScheduleRegistry
+
+        sid = ScheduleRegistry(store).add(op, project=project)
+        click.echo(
+            f"schedule {sid} registered ({op.schedule.kind}); "
+            "a running agent (`polyaxon agent start`) fires it"
+        )
+        return
+    if op.joins:
+        from ..scheduler import resolve_joins
+
+        op = resolve_joins(op, store)
     if op.matrix is not None:
         from ..tuner.driver import run_sweep
 
@@ -228,6 +241,106 @@ def convert(fpath, params, namespace):
     import yaml as _yaml
 
     click.echo(_yaml.safe_dump_all(manifests, sort_keys=False))
+
+
+@cli.group()
+def config():
+    """Client settings (~/.polyaxon/config.json + POLYAXON_* env)."""
+
+
+@config.command("show")
+def config_show():
+    from .. import settings
+
+    click.echo(json.dumps(settings.show(), indent=1))
+
+
+@config.command("get")
+@click.argument("key")
+def config_get(key):
+    from .. import settings
+
+    try:
+        click.echo(settings.get(key))
+    except KeyError as e:
+        raise click.ClickException(str(e))
+
+
+@config.command("set")
+@click.argument("key")
+@click.argument("value")
+def config_set(key, value):
+    from .. import settings
+
+    try:
+        settings.set_value(key, value)
+    except KeyError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"{key} = {value}")
+
+
+@cli.group()
+def project():
+    """Project registry."""
+
+
+@project.command("create")
+@click.argument("name")
+@click.option("--description", default="")
+def project_create(name, description):
+    from ..client import ClientError, ProjectClient
+
+    try:
+        p = ProjectClient(RunStore()).create(name, description)
+    except ClientError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"project {p['name']} created")
+
+
+@project.command("ls")
+def project_ls():
+    from ..client import ProjectClient
+
+    for p in ProjectClient(RunStore()).list():
+        click.echo(f"{p['name']:<24} {p.get('runs', 0):>5} runs  {p.get('description', '')}")
+
+
+@project.command("get")
+@click.argument("name")
+def project_get(name):
+    from ..client import ClientError, ProjectClient
+
+    try:
+        click.echo(json.dumps(ProjectClient(RunStore()).get(name), indent=1))
+    except ClientError as e:
+        raise click.ClickException(str(e))
+
+
+@cli.group()
+def admin():
+    """Platform administration."""
+
+
+@admin.command("deploy")
+@click.option("--namespace", default="polyaxon")
+@click.option("--image", default="polyaxon-tpu/cli:latest")
+@click.option("--store-size", default="50Gi")
+@click.option("--dry-run", is_flag=True, help="print manifests instead of writing")
+@click.option("--out", default="deploy/", help="output dir for manifests")
+def admin_deploy(namespace, image, store_size, dry_run, out):
+    """Render the control-plane manifests (agent, streams, store PVC)."""
+    from ..k8s.deploy import render_deploy, write_deploy
+
+    manifests = render_deploy(
+        namespace=namespace, image=image, store_size=store_size
+    )
+    if dry_run:
+        import yaml as _yaml
+
+        click.echo(_yaml.safe_dump_all(manifests, sort_keys=False))
+        return
+    paths = write_deploy(manifests, out)
+    click.echo(f"wrote {len(paths)} manifests to {out} (kubectl apply -f {out})")
 
 
 def main():

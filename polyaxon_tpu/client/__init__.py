@@ -1,0 +1,3 @@
+"""Client SDK (SURVEY.md §2 "Client SDK" / "SDK clients" rows)."""
+
+from .run_client import ClientError, ProjectClient, RunClient  # noqa: F401

@@ -1,0 +1,199 @@
+"""RunClient / ProjectClient — the SDK surface (SURVEY.md §2 "Client SDK").
+
+Two transports behind one API:
+- local (default): directly over the file-backed run store — what the CLI,
+  tuner, and tracking already use.
+- http: read-side against a streams service (streams/server.py) for remote
+  inspection; mutations stay local-only (the streams service is read-only
+  by design, like upstream's).
+
+    client = RunClient()                       # local
+    client = RunClient(base_url="http://host:8585")   # remote reads
+    uuid = client.create(op)                   # local only
+    client.logs(uuid); client.metrics(uuid); client.statuses(uuid)
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from ..schemas.lifecycle import V1Statuses
+from ..schemas.operation import V1Operation
+from ..store.local import RunStore
+
+
+class ClientError(Exception):
+    pass
+
+
+class _HttpTransport:
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    def get(self, path: str) -> Any:
+        try:
+            with urllib.request.urlopen(self.base_url + path) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            raise ClientError(f"GET {path}: HTTP {e.code}") from e
+        except urllib.error.URLError as e:
+            raise ClientError(f"GET {path}: {e.reason}") from e
+
+
+class RunClient:
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        store: Optional[RunStore] = None,
+        project: str = "default",
+    ):
+        self.project = project
+        self._http = _HttpTransport(base_url) if base_url else None
+        self._store = store if store is not None else (None if base_url else RunStore())
+
+    @property
+    def store(self) -> RunStore:
+        if self._store is None:
+            raise ClientError("mutating operations need a local store (no base_url mode)")
+        return self._store
+
+    # ---------------------------------------------------------------- write
+    def create(self, op: V1Operation, *, queue: bool = True) -> str:
+        """Submit an operation. queue=True enqueues for an agent; False
+        executes THIS run inline to completion (never an arbitrary queue
+        entry — another agent may own older queued work)."""
+        from ..scheduler.agent import Agent
+
+        agent = Agent(store=self.store)
+        uuid = agent.submit(op, project=self.project)
+        if not queue:
+            entry = None
+            remaining = []
+            while True:
+                e = agent.queue.pop()
+                if e is None:
+                    break
+                if e["uuid"] == uuid:
+                    entry = e
+                    break
+                remaining.append(e)
+            for e in remaining:  # put back what belongs to others
+                agent.queue.push(e["uuid"], e["payload"], e.get("priority", 0))
+            if entry is not None:
+                agent._process(entry)
+        return uuid
+
+    def stop(self, uuid: str):
+        uuid = self.store.resolve(uuid)
+        self.store.set_status(uuid, V1Statuses.STOPPING)
+        self.store.set_status(uuid, V1Statuses.STOPPED)
+
+    # ---------------------------------------------------------------- read
+    def _resolve(self, uuid: str) -> str:
+        if self._http:
+            return uuid  # server resolves short uuids
+        return self.store.resolve(uuid)
+
+    def list(self, project: Optional[str] = None) -> list[dict]:
+        if self._http:
+            q = f"?project={project}" if project else ""
+            return self._http.get(f"/runs{q}")
+        return self.store.list_runs(project)
+
+    def get(self, uuid: str) -> dict:
+        uuid = self._resolve(uuid)
+        if self._http:
+            return self._http.get(f"/runs/{uuid}/status")
+        return self.store.get_status(uuid)
+
+    def statuses(self, uuid: str) -> list[dict]:
+        return self.get(uuid).get("conditions", [])
+
+    def logs(self, uuid: str, offset: int = 0) -> str:
+        uuid = self._resolve(uuid)
+        if self._http:
+            return self._http.get(f"/runs/{uuid}/logs?offset={offset}")["logs"]
+        return self.store.read_logs(uuid)[offset:]
+
+    def metrics(self, uuid: str) -> list[dict]:
+        uuid = self._resolve(uuid)
+        if self._http:
+            return self._http.get(f"/runs/{uuid}/metrics")
+        return self.store.read_metrics(uuid)
+
+    def events(self, uuid: str) -> list[dict]:
+        uuid = self._resolve(uuid)
+        if self._http:
+            return self._http.get(f"/runs/{uuid}/events")
+        return self.store.read_events(uuid)
+
+    def artifacts(self, uuid: str) -> list[str]:
+        uuid = self._resolve(uuid)
+        if self._http:
+            return self._http.get(f"/runs/{uuid}/artifacts")["files"]
+        root = self.store.outputs_dir(uuid)
+        return [str(p.relative_to(root)) for p in sorted(root.rglob("*")) if p.is_file()]
+
+    def wait(self, uuid: str, timeout: float = 3600, poll: float = 0.5) -> str:
+        """Block until the run reaches a terminal status."""
+        import time
+
+        from ..schemas.lifecycle import DONE_STATUSES
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get(uuid).get("status")
+            if status in {str(s) for s in DONE_STATUSES} | set(DONE_STATUSES):
+                return status
+            time.sleep(poll)
+        raise TimeoutError(f"run {uuid} not done after {timeout}s")
+
+
+class ProjectClient:
+    """Project registry over the store index (SURVEY.md §2 control-plane
+    "projects" rows — local-first)."""
+
+    def __init__(self, store: Optional[RunStore] = None):
+        self.store = store or RunStore()
+        self.path = self.store.home / "projects.json"
+
+    def _read(self) -> dict:
+        if self.path.exists():
+            return json.loads(self.path.read_text())
+        return {}
+
+    def _write(self, data: dict):
+        self.path.write_text(json.dumps(data, indent=1))
+
+    def create(self, name: str, description: str = "") -> dict:
+        import time
+
+        projects = self._read()
+        if name in projects:
+            raise ClientError(f"project {name!r} already exists")
+        projects[name] = {"name": name, "description": description, "created_at": time.time()}
+        self._write(projects)
+        return projects[name]
+
+    def get(self, name: str) -> dict:
+        projects = self._read()
+        if name not in projects:
+            # implicit projects exist once a run references them
+            runs = self.store.list_runs(name)
+            if runs:
+                return {"name": name, "description": "(implicit)", "runs": len(runs)}
+            raise ClientError(f"unknown project {name!r}")
+        return {**projects[name], "runs": len(self.store.list_runs(name))}
+
+    def list(self) -> list[dict]:
+        projects = dict(self._read())
+        for rec in self.store.list_runs():
+            projects.setdefault(rec["project"], {"name": rec["project"], "description": "(implicit)"})
+        return [self.get(n) for n in sorted(projects)]
+
+    def delete(self, name: str):
+        projects = self._read()
+        projects.pop(name, None)
+        self._write(projects)

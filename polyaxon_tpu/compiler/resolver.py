@@ -250,6 +250,8 @@ def compile_operation(
     iteration: Optional[int] = None,
 ) -> CompiledOperation:
     run_uuid = run_uuid or _uuid.uuid4().hex
+    if op.presets:
+        op = _apply_presets(op, base_dir)
     component = _resolve_component(op, base_dir)
 
     # op-level patches onto the component
@@ -315,6 +317,71 @@ def compile_operation(
         contexts=context,
         operation=op,
     )
+
+
+def _preset_dirs(base_dir: Optional[str]) -> list[Path]:
+    import os
+
+    home = os.environ.get("POLYAXON_HOME")
+    dirs = []
+    if base_dir:
+        dirs.append(Path(base_dir) / ".polyaxon" / "presets")
+    if home:
+        dirs.append(Path(home) / "presets")
+    dirs.append(Path.home() / ".polyaxon" / "presets")
+    return dirs
+
+
+def _apply_presets(op: V1Operation, base_dir: Optional[str]) -> V1Operation:
+    """Merge named preset operations (is_preset fragments stored as YAML in
+    the presets dir) onto the op — op's own fields win (presets fill gaps;
+    patch_strategy inside a preset can override that)."""
+    import yaml
+
+    op_dict = op.to_dict()
+    for name in op.presets or ():
+        found = None
+        for d in _preset_dirs(base_dir):
+            for ext in (".yaml", ".yml", ".json"):
+                p = d / f"{name}{ext}"
+                if p.exists():
+                    found = p
+                    break
+            if found:
+                break
+        if found is None:
+            raise CompilationError(
+                f"preset {name!r} not found in "
+                f"{[str(d) for d in _preset_dirs(base_dir)]}"
+            )
+        try:
+            preset = yaml.safe_load(found.read_text()) or {}
+        except yaml.YAMLError as e:
+            raise CompilationError(f"preset {name!r}: bad YAML: {e}") from e
+        preset.pop("isPreset", None)
+        preset.pop("is_preset", None)
+        preset.pop("kind", None)
+        preset.pop("version", None)
+        strategy = preset.pop("patchStrategy", preset.pop("patch_strategy", "pre_merge"))
+        op_dict = _deep_merge(op_dict, preset, strategy)
+    try:
+        return V1Operation.model_validate(op_dict)
+    except Exception as e:
+        raise CompilationError(f"operation invalid after presets: {e}") from e
+
+
+def spec_fingerprint(compiled: "CompiledOperation") -> str:
+    """Content hash of everything that determines a run's result — used by
+    the cache layer (executor) to dedupe identical runs."""
+    import hashlib
+    import json
+
+    payload = {
+        "component": compiled.component.to_dict(),
+        "params": compiled.params,
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
 
 
 def apply_suggestion(op: V1Operation, suggestion: dict[str, Any]) -> V1Operation:

@@ -1,0 +1,160 @@
+"""Control-plane deployment rendering — `polyaxon admin deploy` parity
+(SURVEY.md §2 "Deploy": helm charts + admin deploy).
+
+Renders the platform's own services as k8s manifests: namespace, a PVC
+backing the shared run store, the agent (queue drainer) Deployment, and
+the streams service Deployment+Service. `--dry-run` prints; otherwise the
+manifests are written to a directory for `kubectl apply -f` (no cluster
+access is assumed from this environment)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+DEFAULT_IMAGE = "polyaxon-tpu/cli:latest"
+
+
+def _store_volume(claim: str) -> tuple[dict, dict]:
+    volume = {
+        "name": "polyaxon-store",
+        "persistentVolumeClaim": {"claimName": claim},
+    }
+    mount = {"name": "polyaxon-store", "mountPath": "/polyaxon-store"}
+    return volume, mount
+
+
+def render_deploy(
+    *,
+    namespace: str = "polyaxon",
+    image: str = DEFAULT_IMAGE,
+    store_size: str = "50Gi",
+    streams_port: int = 8585,
+    agent_replicas: int = 1,
+) -> list[dict]:
+    labels = {"app.kubernetes.io/managed-by": "polyaxon-tpu"}
+    volume, mount = _store_volume("polyaxon-store")
+    env = [{"name": "POLYAXON_HOME", "value": "/polyaxon-store"}]
+
+    ns = {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": namespace, "labels": labels},
+    }
+    pvc = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "polyaxon-store", "namespace": namespace, "labels": labels},
+        "spec": {
+            "accessModes": ["ReadWriteMany"],
+            "resources": {"requests": {"storage": store_size}},
+        },
+    }
+    agent = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "polyaxon-agent", "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": agent_replicas,
+            "selector": {"matchLabels": {"app": "polyaxon-agent"}},
+            "template": {
+                "metadata": {"labels": {**labels, "app": "polyaxon-agent"}},
+                "spec": {
+                    "serviceAccountName": "polyaxon-agent",
+                    "containers": [
+                        {
+                            "name": "agent",
+                            "image": image,
+                            "command": ["python", "-m", "polyaxon_tpu", "agent", "start"],
+                            "env": env,
+                            "volumeMounts": [mount],
+                        }
+                    ],
+                    "volumes": [volume],
+                },
+            },
+        },
+    }
+    sa = {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": "polyaxon-agent", "namespace": namespace, "labels": labels},
+    }
+    # the agent creates Jobs/Services for runs: needs namespace-scoped rbac
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": {"name": "polyaxon-agent", "namespace": namespace, "labels": labels},
+        "rules": [
+            {
+                "apiGroups": ["batch", "apps", ""],
+                "resources": ["jobs", "deployments", "services", "pods", "pods/log"],
+                "verbs": ["create", "get", "list", "watch", "delete"],
+            }
+        ],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {"name": "polyaxon-agent", "namespace": namespace, "labels": labels},
+        "subjects": [
+            {"kind": "ServiceAccount", "name": "polyaxon-agent", "namespace": namespace}
+        ],
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "Role",
+            "name": "polyaxon-agent",
+        },
+    }
+    streams = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "polyaxon-streams", "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "polyaxon-streams"}},
+            "template": {
+                "metadata": {"labels": {**labels, "app": "polyaxon-streams"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "streams",
+                            "image": image,
+                            "command": [
+                                "python", "-m", "polyaxon_tpu", "streams", "start",
+                                "--host", "0.0.0.0", "--port", str(streams_port),
+                            ],
+                            "env": env,
+                            "ports": [{"containerPort": streams_port}],
+                            "volumeMounts": [mount],
+                        }
+                    ],
+                    "volumes": [volume],
+                },
+            },
+        },
+    }
+    streams_svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "polyaxon-streams", "namespace": namespace, "labels": labels},
+        "spec": {
+            "selector": {"app": "polyaxon-streams"},
+            "ports": [{"port": streams_port}],
+        },
+    }
+    return [ns, pvc, sa, role, binding, agent, streams, streams_svc]
+
+
+def write_deploy(manifests: list[dict], out_dir: str) -> list[str]:
+    import yaml
+    from pathlib import Path
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    paths = []
+    for m in manifests:
+        name = f"{m['kind'].lower()}-{m['metadata']['name']}.yaml"
+        p = out / name
+        p.write_text(yaml.safe_dump(m, sort_keys=False))
+        paths.append(str(p))
+    return paths

@@ -87,7 +87,9 @@ def build_bert(config: dict) -> ModelBundle:
         task="mlm",
         sharding_rules=ENCODER_RULES
         + (
-            (r"embed/embedding", ("model", "fsdp")),
+            # hidden-dim sharding keeps the token lookup local (see
+            # transformer.py TRANSFORMER_RULES)
+            (r"embed/embedding", (None, ("model", "fsdp"))),
             (r"mlm_transform/kernel", ("fsdp", "model")),
         ),
     )

@@ -137,9 +137,15 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, S, _ = x.shape
         hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        from ..parallel.sharding import constrain
+
         q = _proj(cfg, nh * hd, "q_proj")(x).reshape(B, S, nh, hd)
         k = _proj(cfg, nkv * hd, "k_proj")(x).reshape(B, S, nkv, hd)
         v = _proj(cfg, nkv * hd, "v_proj")(x).reshape(B, S, nkv, hd)
+        # heads on the model axis (column-parallel QKV output)
+        q = constrain(q, BATCH, "context", "model", None)
+        k = constrain(k, BATCH, "context", "model", None)
+        v = constrain(v, BATCH, "context", "model", None)
         cos_np, sin_np = rope_table(cfg.seq_len, hd, cfg.rope_theta)
         cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
         q = apply_rope(q, cos, sin)
@@ -155,8 +161,11 @@ class Attention(nn.Module):
             q, k, v, causal=True, backend=cfg.attention,
             block_kv=cfg.attention_block,
         )
-        out = out.reshape(B, S, nh * hd)
+        out = constrain(out.reshape(B, S, nh * hd), BATCH, "context", "model")
         return _proj(cfg, cfg.dim, "o_proj")(out)
+
+
+BATCH = ("data", "fsdp")  # logical axes the batch dim may be split over
 
 
 class FeedForward(nn.Module):
@@ -164,10 +173,15 @@ class FeedForward(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from ..parallel.sharding import constrain
+
         cfg = self.cfg
         gate = _proj(cfg, cfg.ffn_dim, "gate_proj")(x)
         up = _proj(cfg, cfg.ffn_dim, "up_proj")(x)
-        return _proj(cfg, cfg.dim, "down_proj")(nn.silu(gate) * up)
+        # column-parallel output: hidden dim lives on the model axis until
+        # the row-parallel down projection reduces it
+        h = constrain(nn.silu(gate) * up, BATCH, "context", "model")
+        return _proj(cfg, cfg.dim, "down_proj")(h)
 
 
 class Block(nn.Module):
@@ -176,7 +190,10 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from ..parallel.sharding import constrain
+
         cfg = self.cfg
+        x = constrain(x, BATCH, "context", None)
         h = Attention(cfg, name="attention")(
             RMSNorm(cfg.norm_eps, name="attention_norm")(x), train=self.train
         )
@@ -311,7 +328,10 @@ class Transformer(nn.Module):
 # layout `layers/block/...` (where kernels gain a leading layer axis — the
 # rule axes then apply to the trailing dims via the sharding resolver).
 TRANSFORMER_RULES = (
-    (r"embed/embedding", ("model", "fsdp")),
+    # hidden dim sharded (model+fsdp): the token lookup stays a LOCAL gather
+    # — vocab-sharding instead makes GSPMD emit a cross-shard gather with
+    # involuntary full rematerialization
+    (r"embed/embedding", (None, ("model", "fsdp"))),
     (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel", ("fsdp", "model")),
     (r"(o_proj|down_proj)/kernel", ("model", "fsdp")),
     (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_a", ("fsdp", None)),

@@ -101,11 +101,13 @@ def pipeline_apply(
         n_stages=n_stages,
         n_micro=n_micro,
     )
-    stacked = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(p_spec, x_spec),
-        out_specs=out_spec,
-        check_rep=False,
-    )(stage_params, x)
+    from .sharding import suspend_constraints
+
+    with suspend_constraints():  # body code must not re-constrain locally
+        stacked = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(p_spec, x_spec),
+            out_specs=out_spec,
+        )(stage_params, x)
     return stacked[-1]  # the last stage's output (XLA inserts the transfer)

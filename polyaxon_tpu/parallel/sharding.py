@@ -36,12 +36,22 @@ def _spec_for(path: str, shape, rules, mesh: Mesh) -> P:
         if re.search(pattern, path):
             resolved = []
             for i, ax in enumerate(axes[: len(shape)]):
-                if ax is None or ax not in mesh.shape or mesh.shape[ax] == 1:
+                cands = ax if isinstance(ax, tuple) else (ax,)
+                live: list = []
+                size = 1
+                for a in cands:
+                    if a is None or mesh.shape.get(a, 1) == 1:
+                        continue
+                    if shape[i] % (size * mesh.shape[a]) == 0:
+                        live.append(a)
+                        size *= mesh.shape[a]
+                    # indivisible under this axis: drop it, keep the rest
+                if not live:
                     resolved.append(None)
-                elif shape[i] % mesh.shape[ax] == 0:
-                    resolved.append(ax)
-                else:  # indivisible dim: replicate rather than fail
-                    resolved.append(None)
+                elif len(live) == 1:
+                    resolved.append(live[0])
+                else:
+                    resolved.append(tuple(live))
             while resolved and resolved[-1] is None:
                 resolved.pop()
             return P(*resolved)
@@ -75,6 +85,66 @@ def batch_sharding(mesh: Mesh, extra_axes: Optional[dict[str, str]] = None):
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+import contextlib
+import threading as _threading
+
+_CONSTRAIN_STATE = _threading.local()
+
+
+@contextlib.contextmanager
+def suspend_constraints():
+    """Disable `constrain` while tracing code that runs inside shard_map
+    (per-device views must not re-apply global sharding constraints)."""
+    prev = getattr(_CONSTRAIN_STATE, "suspended", False)
+    _CONSTRAIN_STATE.suspended = True
+    try:
+        yield
+    finally:
+        _CONSTRAIN_STATE.suspended = prev
+
+
+def constrain(x, *axes):
+    """`with_sharding_constraint` against the trainer-bound mesh
+    (parallel/ring.current_mesh). Axes name logical mesh axes (or tuples of
+    them); axes missing from the mesh degrade to None, and outside any mesh
+    the call is a no-op — so model code can annotate unconditionally.
+
+    Pinning activation layouts stops GSPMD from picking inconsistent
+    shardings between forward and backward (the 'involuntary full
+    rematerialization' warnings on TP meshes — a real resharding on ICI)."""
+    import jax
+
+    from .ring import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or getattr(_CONSTRAIN_STATE, "suspended", False):
+        return x
+    resolved = []
+    for i, ax in enumerate(axes[: x.ndim]):
+        cands = ax if isinstance(ax, tuple) else (ax,)
+        live: list = []
+        size = 1
+        for a in cands:
+            if not a or mesh.shape.get(a, 1) == 1:
+                continue
+            # indivisible dims degrade to replication (e.g. a module traced
+            # directly with a small batch while a big-mesh is bound)
+            if x.shape[i] % (size * mesh.shape[a]) == 0:
+                live.append(a)
+                size *= mesh.shape[a]
+        if not live:
+            resolved.append(None)
+        elif len(live) == 1:
+            resolved.append(live[0])
+        else:
+            resolved.append(tuple(live))
+    while resolved and resolved[-1] is None:
+        resolved.pop()
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*resolved))
+    )
 
 
 def make_global_batch(batch: dict, mesh: Mesh, sharding: NamedSharding):

@@ -37,16 +37,27 @@ class Executor:
     def execute(self, compiled: CompiledOperation) -> str:
         """Run to completion; returns final status. Retries per termination
         spec (maxRetries) — restart-from-checkpoint comes free because the
-        trainer resumes from the run's outputs dir."""
+        trainer resumes from the run's outputs dir. With `cache:` enabled, a
+        prior succeeded run with the same spec fingerprint short-circuits:
+        its metrics/events are linked in and the run succeeds immediately."""
+        from ..compiler.resolver import spec_fingerprint
+
         store = self.store
         run_uuid = compiled.run_uuid
+        fingerprint = spec_fingerprint(compiled)
         store.create_run(
             run_uuid,
             compiled.name,
             compiled.project,
             compiled.to_dict(),
             tags=compiled.operation.tags,
+            meta={"fingerprint": fingerprint},
         )
+        cache = compiled.operation.cache or compiled.component.cache
+        if cache is not None and not cache.disable:
+            hit = self._find_cached(fingerprint, cache.ttl, exclude=run_uuid)
+            if hit is not None:
+                return self._finish_from_cache(compiled, hit)
         # advance through the pre-run lifecycle; skip stages already passed
         # (agent-submitted runs arrive here in QUEUED, direct runs in CREATED)
         from ..schemas.lifecycle import can_transition
@@ -68,6 +79,7 @@ class Executor:
             try:
                 self._run_once(compiled, timeout=timeout, resume=attempt > 0)
                 store.set_status(run_uuid, V1Statuses.SUCCEEDED)
+                self._run_hooks(compiled, V1Statuses.SUCCEEDED)
                 return V1Statuses.SUCCEEDED
             except BaseException as e:  # noqa: BLE001 — record, then decide
                 store.append_log(run_uuid, f"ERROR: {e}\n{traceback.format_exc()}")
@@ -84,7 +96,118 @@ class Executor:
                 store.set_status(
                     run_uuid, V1Statuses.FAILED, reason=type(e).__name__, message=str(e)
                 )
+                self._run_hooks(compiled, V1Statuses.FAILED)
                 return V1Statuses.FAILED
+
+    # ------------------------------------------------------------------ hooks
+    def _run_hooks(self, compiled: CompiledOperation, status: str) -> None:
+        """Post-run hooks (SURVEY.md §2: notifier auxiliaries / op hooks).
+        A pathRef hook compiles+executes that component as its own run with
+        the parent's status injected; hook failures are logged, never
+        propagated into the parent's status."""
+        hooks = compiled.operation.hooks or []
+        store, run_uuid = self.store, compiled.run_uuid
+        for hook in hooks:
+            trigger = hook.trigger or "done"
+            fire = (
+                trigger == "done"
+                or (trigger == "succeeded" and status == V1Statuses.SUCCEEDED)
+                or (trigger == "failed" and status == V1Statuses.FAILED)
+            )
+            if not fire:
+                continue
+            try:
+                if hook.path_ref:
+                    from ..compiler.resolver import compile_operation
+                    from ..schemas.operation import V1Operation
+
+                    params = dict(hook.params or {})
+                    child = V1Operation.model_validate(
+                        {
+                            "name": f"{compiled.name}-hook",
+                            "pathRef": hook.path_ref,
+                            "params": {
+                                **{k: v.to_dict() for k, v in params.items()},
+                                # .value: str() on a str-Enum renders the
+                                # member name, not the lifecycle value
+                                "status": {"value": getattr(status, "value", str(status))},
+                                "run_uuid": {"value": run_uuid},
+                            },
+                        }
+                    )
+                    hook_compiled = compile_operation(
+                        child, project=compiled.project
+                    )
+                    store.append_log(
+                        run_uuid,
+                        f"hook {hook.path_ref}: run {hook_compiled.run_uuid[:8]}",
+                    )
+                    self.execute(hook_compiled)
+                else:
+                    # hubRef/no-ref hooks degrade to a notification event
+                    store.log_event(
+                        run_uuid,
+                        "notification",
+                        {
+                            "hook": hook.hub_ref or "notifier",
+                            "status": getattr(status, "value", str(status)),
+                            "connection": hook.connection,
+                        },
+                    )
+            except Exception as e:  # noqa: BLE001 — hooks never fail the run
+                store.append_log(run_uuid, f"hook error ({hook.path_ref or hook.hub_ref}): {e}")
+
+    # ------------------------------------------------------------------ cache
+    def _find_cached(self, fingerprint: str, ttl, exclude: str):
+        """Most recent succeeded run with the same fingerprint (within ttl)."""
+        import time as _time
+
+        best = None
+        for rec in self.store.list_runs():
+            uuid = rec["uuid"]
+            if uuid == exclude:
+                continue
+            if ttl and rec.get("created_at", 0) < _time.time() - ttl:
+                continue
+            status = self.store.get_status(uuid)
+            if status.get("status") != V1Statuses.SUCCEEDED:
+                continue
+            if status.get("meta", {}).get("fingerprint") != fingerprint:
+                continue
+            if best is None or rec.get("created_at", 0) > best[1]:
+                best = (uuid, rec.get("created_at", 0))
+        return best[0] if best else None
+
+    def _finish_from_cache(self, compiled: CompiledOperation, source_uuid: str) -> str:
+        """Link the cached run's results and succeed without executing."""
+        import shutil
+
+        from ..schemas.lifecycle import can_transition
+
+        store, run_uuid = self.store, compiled.run_uuid
+        for s in (
+            V1Statuses.COMPILED,
+            V1Statuses.QUEUED,
+            V1Statuses.SCHEDULED,
+            V1Statuses.STARTING,
+            V1Statuses.RUNNING,
+        ):
+            current = V1Statuses(store.get_status(run_uuid)["status"])
+            if current != s and can_transition(current, s):
+                store.set_status(run_uuid, s)
+        for fname in ("metrics.jsonl", "events.jsonl"):
+            src = store.run_dir(source_uuid) / fname
+            if src.exists():
+                shutil.copy(src, store.run_dir(run_uuid) / fname)
+        store.log_event(
+            run_uuid, "cache_hit", {"source_run": source_uuid}
+        )
+        store.append_log(
+            run_uuid, f"cache hit: reusing results of run {source_uuid[:8]}"
+        )
+        store.set_status(run_uuid, V1Statuses.SUCCEEDED, reason="cached")
+        self._run_hooks(compiled, V1Statuses.SUCCEEDED)
+        return V1Statuses.SUCCEEDED
 
     # ------------------------------------------------------------------
     def _run_once(self, compiled: CompiledOperation, timeout=None, resume=False):

@@ -6,4 +6,6 @@ transition."""
 
 from .agent import Agent  # noqa: F401
 from .dag import DagError, execute_dag, topo_order  # noqa: F401
+from .joins import JoinError, query_runs, resolve_joins  # noqa: F401
 from .queue import RunQueue  # noqa: F401
+from .schedules import ScheduleError, ScheduleRegistry  # noqa: F401

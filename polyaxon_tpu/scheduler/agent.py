@@ -40,15 +40,24 @@ class Agent:
 
     def submit(self, op: V1Operation, *, project: str = "default", priority: int = 0) -> str:
         """Compile + enqueue (the control-plane half of `polyaxon run`)."""
+        if op.joins:
+            from .joins import resolve_joins
+
+            op = resolve_joins(op, self.store)
         compiled = compile_operation(
             op, project=project, artifacts_root=str(self.store.runs_dir)
         )
+        from ..compiler.resolver import spec_fingerprint
+
         self.store.create_run(
             compiled.run_uuid,
             compiled.name,
             compiled.project,
             compiled.to_dict(),
             tags=compiled.operation.tags,
+            # recorded at creation: the executor's later create_run is a
+            # no-op for existing runs, and the cache matches on this meta
+            meta={"fingerprint": spec_fingerprint(compiled)},
         )
         self.store.set_status(compiled.run_uuid, V1Statuses.COMPILED)
         self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
@@ -94,7 +103,14 @@ class Agent:
         return count
 
     def serve(self, poll_interval: float = 1.0, stop_when=lambda: False):
-        """Long-running loop: poll, execute, repeat."""
+        """Long-running loop: fire due schedules, poll the queue, repeat."""
+        from .schedules import ScheduleRegistry
+
+        registry = ScheduleRegistry(self.store)
         while not stop_when():
+            try:
+                registry.tick(self)
+            except Exception as e:  # noqa: BLE001 — a bad schedule never kills the agent
+                print(f"schedule tick error: {e}")
             if self.drain(max_runs=1) == 0:
                 time.sleep(poll_interval)

@@ -27,7 +27,13 @@ from ..schemas.lifecycle import V1Statuses, can_transition, is_done
 
 
 def polyaxon_home() -> Path:
-    return Path(os.environ.get("POLYAXON_HOME", Path.home() / ".polyaxon"))
+    """Env wins, then the user config file, then the default (settings.py)."""
+    env = os.environ.get("POLYAXON_HOME")
+    if env:
+        return Path(env)
+    from ..settings import get as _get_setting
+
+    return Path(_get_setting("home"))
 
 
 class RunStore:

@@ -1,0 +1,114 @@
+"""Framework callbacks: hook third-party training loops into tracking.
+
+Reference parity (SURVEY.md §2 "Traceml": Keras/Lightning/HF/sklearn
+callbacks). Provided here for the stacks in this image:
+
+- `PolyaxonHFCallback` — transformers.TrainerCallback: logs HF trainer
+  metrics per logging step plus the final summary.
+- `PolyaxonKerasCallback` — keras.callbacks.Callback shape (soft import:
+  works with any object exposing the on_epoch_end protocol).
+- `polyaxon_log_fn()` — the generic adapter: a `(step, metrics)` callable
+  for this repo's own Trainer or any custom loop.
+
+All callbacks attach to the active tracked run (tracking.init / env vars).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .run import Run, get_or_create_run
+
+
+def polyaxon_log_fn(run: Optional[Run] = None):
+    run = run or get_or_create_run()
+
+    def log_fn(step: int, metrics: dict[str, Any]):
+        run.log_metrics(step=step, **{k: float(v) for k, v in metrics.items()})
+
+    return log_fn
+
+
+try:  # transformers is in the image; keep the import soft anyway
+    from transformers import TrainerCallback as _HFTrainerCallback
+except Exception:  # pragma: no cover - absent transformers
+    _HFTrainerCallback = object
+
+
+class PolyaxonHFCallback(_HFTrainerCallback):
+    """`transformers.Trainer(callbacks=[PolyaxonHFCallback()])`."""
+
+    def __init__(self, run: Optional[Run] = None):
+        self._run = run
+
+    @property
+    def run(self) -> Run:
+        if self._run is None:
+            self._run = get_or_create_run()
+        return self._run
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if not logs:
+            return
+        metrics = {
+            k: float(v) for k, v in logs.items() if isinstance(v, (int, float))
+        }
+        if metrics:
+            self.run.log_metrics(step=int(state.global_step), **metrics)
+
+    def on_train_end(self, args, state, control, **kwargs):
+        self.run.log_outputs(
+            global_step=int(state.global_step),
+            epochs=float(state.epoch or 0),
+        )
+
+
+class PolyaxonKerasCallback:
+    """Keras-protocol callback (duck-typed so it works without tf/keras
+    importable): attach with `model.fit(..., callbacks=[cb])`."""
+
+    def __init__(self, run: Optional[Run] = None):
+        self._run = run
+        self.params: dict = {}
+        self.model = None
+
+    @property
+    def run(self) -> Run:
+        if self._run is None:
+            self._run = get_or_create_run()
+        return self._run
+
+    # keras callback protocol ------------------------------------------
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None):
+        logs = logs or {}
+        metrics = {k: float(v) for k, v in logs.items() if isinstance(v, (int, float))}
+        if metrics:
+            self.run.log_metrics(step=int(epoch), **metrics)
+
+    def on_train_end(self, logs: Optional[dict] = None):
+        if logs:
+            self.run.log_outputs(
+                **{k: float(v) for k, v in logs.items() if isinstance(v, (int, float))}
+            )
+
+    # unused protocol slots (keras calls them)
+    def on_train_begin(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_batch_begin(self, batch, logs=None): ...
+    def on_batch_end(self, batch, logs=None): ...
+    def on_train_batch_begin(self, batch, logs=None): ...
+    def on_train_batch_end(self, batch, logs=None): ...
+    def on_test_begin(self, logs=None): ...
+    def on_test_end(self, logs=None): ...
+    def on_test_batch_begin(self, batch, logs=None): ...
+    def on_test_batch_end(self, batch, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_predict_batch_begin(self, batch, logs=None): ...
+    def on_predict_batch_end(self, batch, logs=None): ...

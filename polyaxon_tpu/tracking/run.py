@@ -95,6 +95,52 @@ class Run:
     def log_text(self, text: str):
         self.store.append_log(self.uuid, text)
 
+    def log_image(self, data, name: str, step: Optional[int] = None):
+        """Image event: `data` is a path (copied) or an array (saved .npy —
+        PNG encoders aren't in the base image). Recorded with lineage."""
+        import numpy as _np
+
+        img_dir = self.outputs_path / "images"
+        img_dir.mkdir(parents=True, exist_ok=True)
+        if isinstance(data, (str, Path)):
+            dst = img_dir / Path(data).name
+            shutil.copy2(data, dst)
+        else:
+            dst = img_dir / f"{name}.npy"
+            _np.save(dst, _np.asarray(data))
+        self.store.log_event(
+            self.uuid,
+            "image",
+            {"name": name, "path": str(dst), "step": step if step is not None else self._step},
+        )
+        return str(dst)
+
+    def log_histogram(
+        self, name: str, values, bins: int = 30, step: Optional[int] = None
+    ):
+        """Histogram event: bin edges + counts stored inline (renderable by
+        any consumer without touching artifacts)."""
+        import numpy as _np
+
+        counts, edges = _np.histogram(_np.asarray(values).ravel(), bins=bins)
+        self.store.log_event(
+            self.uuid,
+            "histogram",
+            {
+                "name": name,
+                "counts": counts.tolist(),
+                "edges": edges.tolist(),
+                "step": step if step is not None else self._step,
+            },
+        )
+
+    def log_html(self, name: str, html: str):
+        dst = self.outputs_path / f"{name}.html"
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(html)
+        self.store.log_event(self.uuid, "html", {"name": name, "path": str(dst)})
+        return str(dst)
+
     # ------------------------------------------------------------- info
     @property
     def outputs_path(self) -> Path:

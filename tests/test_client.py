@@ -1,0 +1,96 @@
+"""Client SDK (RunClient/ProjectClient, local + HTTP transports) and the
+layered settings manager."""
+
+import json
+
+import pytest
+import yaml
+
+from polyaxon_tpu.client import ClientError, ProjectClient, RunClient
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.store.local import RunStore
+from polyaxon_tpu.streams import BackgroundServer
+
+FAST_OP = {
+    "version": 1.1,
+    "kind": "operation",
+    "name": "client-job",
+    "component": {
+        "kind": "component",
+        "name": "c",
+        "run": {"kind": "job", "container": {"command": ["sh", "-c", "echo out-line"]}},
+    },
+}
+
+
+def _op(tmp_path, spec=FAST_OP):
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    return read_polyaxonfile(str(p))
+
+
+def test_run_client_create_and_read(tmp_home, tmp_path):
+    client = RunClient()
+    uuid = client.create(_op(tmp_path), queue=False)
+    assert client.get(uuid)["status"] == V1Statuses.SUCCEEDED
+    assert "out-line" in client.logs(uuid)
+    assert any(c["type"] == "succeeded" for c in client.statuses(uuid))
+    assert client.list()[0]["uuid"] == uuid
+
+
+def test_run_client_queued_then_wait(tmp_home, tmp_path):
+    import threading
+
+    from polyaxon_tpu.scheduler import Agent
+
+    client = RunClient()
+    uuid = client.create(_op(tmp_path), queue=True)
+    assert client.get(uuid)["status"] == V1Statuses.QUEUED
+    t = threading.Thread(target=lambda: Agent(store=client.store).drain())
+    t.start()
+    status = client.wait(uuid, timeout=60)
+    t.join()
+    assert status == V1Statuses.SUCCEEDED
+
+
+def test_run_client_http_transport(tmp_home, tmp_path):
+    local = RunClient()
+    uuid = local.create(_op(tmp_path), queue=False)
+    with BackgroundServer(local.store) as srv:
+        remote = RunClient(base_url=f"http://127.0.0.1:{srv.port}")
+        assert remote.get(uuid)["status"] == "succeeded"
+        assert "out-line" in remote.logs(uuid)
+        assert remote.list()[0]["uuid"] == uuid
+        with pytest.raises(ClientError):
+            remote.create(_op(tmp_path))  # mutations need local store
+
+
+def test_project_client(tmp_home, tmp_path):
+    store = RunStore()
+    projects = ProjectClient(store)
+    projects.create("vision", "image models")
+    with pytest.raises(ClientError):
+        projects.create("vision")
+    client = RunClient(store=store, project="vision")
+    client.create(_op(tmp_path), queue=False)
+    got = projects.get("vision")
+    assert got["runs"] == 1
+    names = [p["name"] for p in projects.list()]
+    assert "vision" in names
+
+
+def test_settings_layering(tmp_path, monkeypatch):
+    from polyaxon_tpu import settings
+
+    monkeypatch.setenv("POLYAXON_CONFIG_DIR", str(tmp_path))
+    monkeypatch.delenv("POLYAXON_PROJECT", raising=False)
+    assert settings.get("project") == "default"
+    settings.set_value("project", "from-file")
+    assert settings.get("project") == "from-file"
+    monkeypatch.setenv("POLYAXON_PROJECT", "from-env")
+    assert settings.get("project") == "from-env"  # env wins
+    with pytest.raises(KeyError):
+        settings.get("nope")
+    data = json.loads((tmp_path / "config.json").read_text())
+    assert data == {"project": "from-file"}

@@ -1,0 +1,327 @@
+"""Presets, cache, hooks, schedules, joins — the operation-level control
+plane features (SURVEY.md §2 spec rows beyond the core run path)."""
+
+import datetime as dt
+import os
+
+import pytest
+import yaml
+
+from polyaxon_tpu.compiler.resolver import (
+    CompilationError,
+    compile_operation,
+    spec_fingerprint,
+)
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.runtime.executor import Executor
+from polyaxon_tpu.scheduler import (
+    Agent,
+    ScheduleRegistry,
+    query_runs,
+    resolve_joins,
+)
+from polyaxon_tpu.scheduler.schedules import cron_matches, next_fire_time
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.schemas.operation import V1Operation, V1Schedule
+from polyaxon_tpu.store.local import RunStore
+
+FAST_JOB = {
+    "kind": "component",
+    "name": "fast",
+    "run": {"kind": "job", "container": {"command": ["true"]}},
+}
+
+
+def _op(tmp_path, spec, params=None, fname="op.yaml"):
+    p = tmp_path / fname
+    p.write_text(yaml.safe_dump(spec))
+    return read_polyaxonfile(str(p), params=params)
+
+
+# ------------------------------------------------------------------ presets
+def test_presets_merge(tmp_home, tmp_path):
+    presets_dir = tmp_home / "presets"
+    presets_dir.mkdir(parents=True)
+    (presets_dir / "gpu-defaults.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "termination": {"maxRetries": 3},
+                "tags": ["preset-tag"],
+            }
+        )
+    )
+    op = _op(
+        tmp_path,
+        {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "p",
+            "presets": ["gpu-defaults"],
+            "component": FAST_JOB,
+        },
+    )
+    compiled = compile_operation(op)
+    assert compiled.component.termination.max_retries == 3
+
+
+def test_presets_do_not_override_op(tmp_home, tmp_path):
+    presets_dir = tmp_home / "presets"
+    presets_dir.mkdir(parents=True)
+    (presets_dir / "t.yaml").write_text(
+        yaml.safe_dump({"termination": {"maxRetries": 3}})
+    )
+    op = _op(
+        tmp_path,
+        {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "p",
+            "presets": ["t"],
+            "termination": {"maxRetries": 7},
+            "component": FAST_JOB,
+        },
+    )
+    compiled = compile_operation(op)
+    assert compiled.component.termination.max_retries == 7  # op wins
+
+
+def test_missing_preset_raises(tmp_home, tmp_path):
+    op = _op(
+        tmp_path,
+        {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "p",
+            "presets": ["nope"],
+            "component": FAST_JOB,
+        },
+    )
+    with pytest.raises(CompilationError, match="preset 'nope'"):
+        compile_operation(op)
+
+
+# ------------------------------------------------------------------ cache
+def test_cache_hit_reuses_results(tmp_home, tmp_path):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "cached",
+        "cache": {},
+        "component": {
+            "kind": "component",
+            "name": "c",
+            "run": {
+                "kind": "job",
+                "container": {"command": ["sh", "-c", "echo did-work"]},
+            },
+        },
+    }
+    store = RunStore()
+    c1 = compile_operation(_op(tmp_path, spec))
+    assert Executor(store).execute(c1) == V1Statuses.SUCCEEDED
+    c2 = compile_operation(_op(tmp_path, spec, fname="op2.yaml"))
+    assert spec_fingerprint(c1) == spec_fingerprint(c2)
+    assert Executor(store).execute(c2) == V1Statuses.SUCCEEDED
+    # second run never executed the container: it linked the first run
+    events = store.read_events(c2.run_uuid)
+    assert any(e.get("kind") == "cache_hit" for e in events)
+    assert "did-work" not in store.read_logs(c2.run_uuid)
+
+
+def test_cache_miss_on_param_change(tmp_home, tmp_path):
+    base = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "cached",
+        "cache": {},
+        "component": {
+            "kind": "component",
+            "name": "c",
+            "inputs": [{"name": "x", "type": "int", "value": 1}],
+            "run": {
+                "kind": "job",
+                "container": {"command": ["true"]},
+            },
+        },
+    }
+    store = RunStore()
+    c1 = compile_operation(_op(tmp_path, base))
+    Executor(store).execute(c1)
+    c2 = compile_operation(_op(tmp_path, base, params={"x": 2}, fname="b.yaml"))
+    Executor(store).execute(c2)
+    assert not any(
+        e.get("kind") == "cache_hit" for e in store.read_events(c2.run_uuid)
+    )
+
+
+# ------------------------------------------------------------------ hooks
+def test_hook_fires_on_success(tmp_home, tmp_path):
+    hook_file = tmp_path / "notify.yaml"
+    hook_file.write_text(
+        yaml.safe_dump(
+            {
+                "version": 1.1,
+                "kind": "component",
+                "name": "notify",
+                "inputs": [
+                    {"name": "status", "type": "str", "value": "none"},
+                    {"name": "run_uuid", "type": "str", "value": ""},
+                ],
+                "run": {
+                    "kind": "job",
+                    "container": {
+                        "command": ["sh", "-c", "echo hook-ran-{{ params.status }}"]
+                    },
+                },
+            }
+        )
+    )
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "with-hook",
+        "hooks": [{"pathRef": str(hook_file), "trigger": "succeeded"}],
+        "component": FAST_JOB,
+    }
+    store = RunStore()
+    compiled = compile_operation(_op(tmp_path, spec))
+    assert Executor(store).execute(compiled) == V1Statuses.SUCCEEDED
+    runs = store.list_runs()
+    hook_runs = [r for r in runs if r["name"] == "with-hook-hook"]
+    assert hook_runs
+    logs = store.read_logs(hook_runs[0]["uuid"])
+    assert "hook-ran" in logs
+
+
+def test_hook_skipped_on_wrong_trigger(tmp_home, tmp_path):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "with-hook",
+        "hooks": [{"hubRef": "notifier", "trigger": "failed"}],
+        "component": FAST_JOB,
+    }
+    store = RunStore()
+    compiled = compile_operation(_op(tmp_path, spec))
+    Executor(store).execute(compiled)
+    events = store.read_events(compiled.run_uuid)
+    assert not any(e.get("kind") == "notification" for e in events)
+
+
+# ------------------------------------------------------------------ schedules
+def test_cron_matcher():
+    t = dt.datetime(2026, 7, 29, 14, 30)  # Wednesday
+    assert cron_matches("30 14 * * *", t)
+    assert cron_matches("*/15 * * * *", t)
+    assert cron_matches("30 14 29 7 3", t)
+    assert not cron_matches("31 14 * * *", t)
+    assert not cron_matches("30 14 * * 0", t)  # not Sunday
+
+
+def test_interval_schedule_next_fire():
+    s = V1Schedule(kind="interval", frequency=3600)
+    now = dt.datetime(2026, 7, 29, 12, 0)
+    first = next_fire_time(s, now, None)
+    assert first == now + dt.timedelta(seconds=3600)
+    second = next_fire_time(s, first, first)
+    assert second == first + dt.timedelta(seconds=3600)
+
+
+def test_schedule_registry_tick(tmp_home, tmp_path):
+    op = _op(
+        tmp_path,
+        {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "scheduled-job",
+            "schedule": {"kind": "interval", "frequency": 60, "maxRuns": 2},
+            "component": FAST_JOB,
+        },
+    )
+    store = RunStore()
+    registry = ScheduleRegistry(store)
+    registry.add(op)
+    agent = Agent(store=store)
+    now = dt.datetime.now()
+    assert registry.tick(agent, now) == 0  # not due yet
+    assert registry.tick(agent, now + dt.timedelta(seconds=61)) == 1
+    assert registry.tick(agent, now + dt.timedelta(seconds=200)) == 1
+    # maxRuns=2 exhausted: registry empties
+    assert registry.list() == []
+    assert agent.drain() == 2
+    statuses = [store.get_status(r["uuid"])["status"] for r in store.list_runs()]
+    assert statuses.count(V1Statuses.SUCCEEDED) == 2
+
+
+# ------------------------------------------------------------------ joins
+def _seed_runs(store):
+    for i, (loss, status, tag) in enumerate(
+        [(0.1, "succeeded", "sweep"), (0.5, "succeeded", "sweep"), (0.3, "failed", "sweep")]
+    ):
+        uuid = f"{i:032x}"
+        store.create_run(uuid, f"r{i}", "default", {}, tags=[tag])
+        store.log_metrics(uuid, 1, {"loss": loss})
+        for s in ("compiled", "queued", "scheduled", "starting", "running", status):
+            store.set_status(uuid, s)
+    return store
+
+
+def test_query_runs_filters_and_sorts(tmp_home):
+    store = _seed_runs(RunStore())
+    got = query_runs(store, "status:succeeded tag:sweep", sort="metrics.loss")
+    assert [r["metrics"]["loss"] for r in got] == [0.1, 0.5]
+    got = query_runs(store, "metrics.loss:<0.4", sort="-metrics.loss")
+    assert [r["metrics"]["loss"] for r in got] == [0.3, 0.1]
+
+
+def test_resolve_joins_injects_params(tmp_home, tmp_path):
+    store = _seed_runs(RunStore())
+    op = _op(
+        tmp_path,
+        {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "ensemble",
+            "joins": [
+                {
+                    "query": "status:succeeded",
+                    "sort": "metrics.loss",
+                    "limit": 2,
+                    "params": {
+                        "uuids": {"ref": "runs.uuid"},
+                        "losses": {"ref": "runs.outputs.loss"},
+                    },
+                }
+            ],
+            "component": FAST_JOB,
+        },
+    )
+    resolved = resolve_joins(op, store)
+    assert resolved.joins is None
+    assert resolved.params["losses"].value == [0.1, 0.5]
+    assert len(resolved.params["uuids"].value) == 2
+
+
+def test_cache_hits_on_agent_path(tmp_home, tmp_path):
+    """Fingerprint meta is recorded at submit time, so queued runs cache."""
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "agent-cached",
+        "cache": {},
+        "component": FAST_JOB,
+    }
+    store = RunStore()
+    agent = Agent(store=store)
+    u1 = agent.submit(_op(tmp_path, spec))
+    u2 = agent.submit(_op(tmp_path, spec, fname="again.yaml"))
+    assert agent.drain() == 2
+    assert store.get_status(u2)["status"] == V1Statuses.SUCCEEDED
+    assert any(e.get("kind") == "cache_hit" for e in store.read_events(u2))
+
+
+def test_cron_dom_dow_or_semantics():
+    # '0 0 1 * 1': midnight on the 1st OR on Mondays (standard cron OR rule)
+    assert cron_matches("0 0 1 * 1", dt.datetime(2026, 7, 1, 0, 0))   # a Wednesday, dom matches
+    assert cron_matches("0 0 1 * 1", dt.datetime(2026, 7, 6, 0, 0))   # a Monday, dow matches
+    assert not cron_matches("0 0 1 * 1", dt.datetime(2026, 7, 7, 0, 0))  # neither

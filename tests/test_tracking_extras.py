@@ -1,0 +1,87 @@
+"""Tracking extras: event kinds (image/histogram/html), framework
+callbacks, deploy rendering."""
+
+import numpy as np
+
+from polyaxon_tpu import tracking
+from polyaxon_tpu.k8s.deploy import render_deploy, write_deploy
+from polyaxon_tpu.store.local import RunStore
+from polyaxon_tpu.tracking.callbacks import (
+    PolyaxonHFCallback,
+    PolyaxonKerasCallback,
+    polyaxon_log_fn,
+)
+
+
+def _fresh_run(monkeypatch):
+    monkeypatch.delenv("POLYAXON_RUN_UUID", raising=False)
+    monkeypatch.delenv("POLYAXON_RUN_OUTPUTS_PATH", raising=False)
+    return tracking.init(name="extras")
+
+
+def test_event_kinds(tmp_home, monkeypatch):
+    run = _fresh_run(monkeypatch)
+    run.log_image(np.zeros((4, 4, 3)), name="sample")
+    run.log_histogram("weights", np.random.default_rng(0).normal(size=256))
+    run.log_html("report", "<h1>hi</h1>")
+    run.end()
+    store = RunStore()
+    kinds = [e["kind"] for e in store.read_events(run.uuid)]
+    assert {"image", "histogram", "html"} <= set(kinds)
+    hist = next(e for e in store.read_events(run.uuid) if e["kind"] == "histogram")
+    assert sum(hist["counts"]) == 256
+    files = list((store.outputs_dir(run.uuid)).rglob("*"))
+    assert any(p.suffix == ".npy" for p in files)
+    assert any(p.suffix == ".html" for p in files)
+
+
+def test_keras_style_callback(tmp_home, monkeypatch):
+    run = _fresh_run(monkeypatch)
+    cb = PolyaxonKerasCallback(run)
+    cb.set_params({"epochs": 2})
+    cb.on_epoch_end(0, {"loss": 1.5, "acc": 0.5, "name": "skipme"})
+    cb.on_epoch_end(1, {"loss": 1.0, "acc": 0.7})
+    cb.on_train_end({"loss": 1.0})
+    run.end()
+    metrics = RunStore().read_metrics(run.uuid)
+    assert [m["loss"] for m in metrics] == [1.5, 1.0]
+
+
+def test_hf_callback_logs(tmp_home, monkeypatch):
+    run = _fresh_run(monkeypatch)
+    cb = PolyaxonHFCallback(run)
+
+    class State:
+        global_step = 7
+        epoch = 1.0
+
+    cb.on_log(None, State(), None, logs={"loss": 0.3, "lr": 1e-4, "txt": "no"})
+    cb.on_train_end(None, State(), None)
+    run.end()
+    store = RunStore()
+    metrics = store.read_metrics(run.uuid)
+    assert metrics[0]["step"] == 7 and metrics[0]["loss"] == 0.3
+    assert any(e["kind"] == "outputs" for e in store.read_events(run.uuid))
+
+
+def test_generic_log_fn(tmp_home, monkeypatch):
+    run = _fresh_run(monkeypatch)
+    fn = polyaxon_log_fn(run)
+    fn(3, {"loss": 0.9})
+    run.end()
+    assert RunStore().read_metrics(run.uuid)[0]["step"] == 3
+
+
+def test_deploy_rendering(tmp_path):
+    manifests = render_deploy(namespace="mlops", streams_port=9000)
+    kinds = [m["kind"] for m in manifests]
+    assert kinds.count("Deployment") == 2
+    assert "PersistentVolumeClaim" in kinds and "Role" in kinds
+    agent = next(
+        m for m in manifests if m["metadata"]["name"] == "polyaxon-agent" and m["kind"] == "Deployment"
+    )
+    assert agent["metadata"]["namespace"] == "mlops"
+    cmd = agent["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "agent" in cmd
+    paths = write_deploy(manifests, str(tmp_path / "deploy"))
+    assert len(paths) == len(manifests)
