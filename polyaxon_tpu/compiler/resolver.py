@@ -115,13 +115,18 @@ def _normalize_legacy_kind(component: V1Component) -> V1Component:
     """tfjob/pytorchjob/mpijob → jaxjob: replica counts carry over, NCCL/MPI
     rendezvous env becomes jax.distributed coordinator wiring (north star)."""
     run = component.run
-    if run.kind not in ("tfjob", "pytorchjob", "mpijob"):
-        return component
-    replica_groups = {
+    replica_group_map = {
         "tfjob": ("chief", "worker", "evaluator"),  # ps unsupported on TPU
         "pytorchjob": ("master", "worker"),
         "mpijob": ("launcher", "worker"),
-    }[run.kind]
+        "xgboostjob": ("master", "worker"),
+        "paddlejob": ("master", "worker"),
+        "daskjob": ("job", "scheduler", "worker"),
+        "rayjob": ("head", "worker"),
+    }
+    if run.kind not in replica_group_map:
+        return component
+    replica_groups = replica_group_map[run.kind]
     if run.kind == "tfjob" and run.ps is not None:
         raise CompilationError(
             "tfjob with parameter servers cannot map to TPU SPMD; "

@@ -9,8 +9,9 @@ delegation): workers rendezvous via `jax.distributed`, shard over a
 a container command or a native `program:` (model/data/optimizer/train config
 interpreted by polyaxon_tpu/runtime/).
 
-Legacy distributed kinds (tfjob/pytorchjob/mpijob) parse for compatibility and
-are normalized to JAXJob by the compiler (compiler/resolver.py).
+Legacy distributed kinds (tfjob/pytorchjob/mpijob/xgboostjob/paddlejob/
+daskjob/rayjob) parse for compatibility and are normalized to JAXJob by the
+compiler (compiler/resolver.py).
 """
 
 from __future__ import annotations
@@ -224,6 +225,43 @@ class V1MPIJob(BaseSchema):
     program: Optional[V1Program] = None
 
 
+class V1XGBoostJob(BaseSchema):
+    kind: Literal["xgboostjob"] = "xgboostjob"
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    clean_pod_policy: Optional[str] = None
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
+class V1PaddleJob(BaseSchema):
+    kind: Literal["paddlejob"] = "paddlejob"
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    clean_pod_policy: Optional[str] = None
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
+class V1DaskJob(BaseSchema):
+    kind: Literal["daskjob"] = "daskjob"
+    job: Optional[V1KFReplica] = None
+    scheduler: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
+class V1RayJob(BaseSchema):
+    kind: Literal["rayjob"] = "rayjob"
+    head: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    entrypoint: Optional[str] = None
+    ray_version: Optional[str] = None
+    mesh: Optional[V1MeshSpec] = None
+    program: Optional[V1Program] = None
+
+
 class V1TunerJob(BaseSchema):
     """Auxiliary tuner job driving a matrix sweep (Polytune)."""
 
@@ -263,6 +301,10 @@ V1RunKind = Union[
     V1TFJob,
     V1PyTorchJob,
     V1MPIJob,
+    V1XGBoostJob,
+    V1PaddleJob,
+    V1DaskJob,
+    V1RayJob,
     V1TunerJob,
     V1Dag,
 ]
@@ -278,6 +320,10 @@ RUN_KINDS: dict[str, type] = {
     "tfjob": V1TFJob,
     "pytorchjob": V1PyTorchJob,
     "mpijob": V1MPIJob,
+    "xgboostjob": V1XGBoostJob,
+    "paddlejob": V1PaddleJob,
+    "daskjob": V1DaskJob,
+    "rayjob": V1RayJob,
     "tuner": V1TunerJob,
     "dag": V1Dag,
 }

@@ -207,3 +207,32 @@ def test_legacy_kinds_parse():
             }
         )
         assert r.kind == kind
+
+
+def test_extra_legacy_kinds_parse_and_normalize():
+    """xgboost/paddle/dask/ray jobs parse and compile down to JAXJob gangs."""
+    from polyaxon_tpu.compiler.resolver import compile_operation
+    from polyaxon_tpu.schemas.component import V1Component
+    from polyaxon_tpu.schemas.operation import V1Operation
+
+    for kind, groups in (
+        ("xgboostjob", {"master": {"replicas": 1}, "worker": {"replicas": 3}}),
+        ("paddlejob", {"worker": {"replicas": 2}}),
+        ("daskjob", {"scheduler": {"replicas": 1}, "worker": {"replicas": 2}}),
+        ("rayjob", {"head": {"replicas": 1}, "worker": {"replicas": 4}}),
+    ):
+        groups = {
+            g: {**spec, "container": {"image": "x", "command": ["run"]}}
+            for g, spec in groups.items()
+        }
+        op = V1Operation(
+            name=f"legacy-{kind}",
+            component=V1Component.model_validate(
+                {"kind": "component", "name": kind, "run": {"kind": kind, **groups}}
+            ),
+        )
+        compiled = compile_operation(op)
+        assert compiled.run.kind == "jaxjob"
+        assert compiled.run.replicas == sum(
+            g["replicas"] for g in groups.values()
+        )
