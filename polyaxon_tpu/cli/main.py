@@ -344,6 +344,16 @@ def admin_deploy(namespace, image, store_size, dry_run, out):
 
 
 def main():
+    # `POLYAXON_JAX_PLATFORM=cpu POLYAXON_NUM_CPU_DEVICES=8 polyaxon run ...`
+    # drives a virtual 8-device slice on a laptop/CI box
+    from ..utils.jax_platform import PlatformEnvError, apply_platform_env
+
+    try:
+        apply_platform_env()
+    except PlatformEnvError as e:
+        raise click.ClickException(str(e))
+    except RuntimeError as e:  # backend already up — surface, don't crash
+        click.echo(f"warning: could not apply platform env: {e}", err=True)
     cli()
 
 

@@ -311,6 +311,15 @@ class Executor:
         _json.dump(payload, spec_file)
         spec_file.close()
         term = compiled.component.termination
+        # Local gangs are the CPU stand-in for a multi-host pod: N processes
+        # on one host cannot share the single TPU chip, so force workers onto
+        # a virtual CPU backend (worker.py applies this via jax.config —
+        # plain JAX_PLATFORMS env loses to the axon TPU plugin). On a real
+        # cluster, workers go through the k8s converter, not this path.
+        from ..utils.jax_platform import env_n_cpu, env_platform
+
+        platform = env_platform() or "cpu"
+        n_cpu = env_n_cpu()  # validated here: one clear error, not N worker crashes
         cmd = [
             launcher_path(),
             "--num-workers", str(replicas),
@@ -323,6 +332,8 @@ class Executor:
             ),
             "--env", f"POLYAXON_PROGRAM_SPEC={spec_file.name}",
             "--env", f"POLYAXON_HOME={store.home}",
+            "--env", f"POLYAXON_JAX_PLATFORM={platform}",
+            "--env", f"POLYAXON_NUM_CPU_DEVICES={n_cpu}",
             "--", sys.executable, "-m", "polyaxon_tpu.runtime.worker",
         ]
         store.set_status(run_uuid, V1Statuses.RUNNING)

@@ -347,6 +347,17 @@ class Trainer:
         prof_stop = int(tspec.profile_stop) if tspec.profile_stop is not None else None
         profiling = False
 
+        # dispatch back-pressure: the async dispatch queue must stay bounded
+        # or queued steps exhaust XLA's collective thread pool on multi-device
+        # CPU meshes (observed: abort at an all-reduce rendezvous with 7/8
+        # threads after ~100 unflushed steps). Blocking on step N-K keeps K
+        # steps in flight — deep enough that dispatch never stalls the device,
+        # shallow enough that the host can't run away.
+        import collections as _collections
+
+        inflight: _collections.deque = _collections.deque()
+        max_inflight = 4
+
         t0 = time.perf_counter()
         for step in range(start_step, self.steps):
             if prof_start is not None and step == prof_start and self.artifacts_dir:
@@ -356,6 +367,9 @@ class Trainer:
             if isinstance(batch, BaseException):
                 raise batch
             self.state, metrics = self.train_step(self.state, batch)
+            inflight.append(metrics["loss"])
+            if len(inflight) > max_inflight:
+                inflight.popleft().block_until_ready()
             if profiling and prof_stop is not None and step + 1 >= prof_stop:
                 jax.block_until_ready(metrics["loss"])
                 jax.profiler.stop_trace()

@@ -26,6 +26,14 @@ def main() -> int:
 
     import jax
 
+    # Platform selection for a FRESH interpreter: without this every local
+    # gang worker grabs the one real TPU chip and deadlocks in rendezvous.
+    # The executor injects these for local gangs; the k8s converter leaves
+    # them unset on real TPU pods.
+    from ..utils.jax_platform import apply_platform_env
+
+    apply_platform_env()
+
     if num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coord,

@@ -1,0 +1,64 @@
+"""Platform selection that survives the axon TPU-tunnel plugin.
+
+The plugin pre-sets JAX_PLATFORMS and wins over plain env vars, so forcing a
+virtual CPU slice (tests, local gangs, CI dryruns) must go through
+`jax.config` BEFORE the first backend touch. This is the one shared copy of
+that dance; tests/conftest.py inlines the same two calls because it must run
+before any package import.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class PlatformEnvError(Exception):
+    pass
+
+
+def parse_n_cpu(value: Optional[str], source: str) -> int:
+    if value is None:
+        return 1
+    try:
+        return int(value.strip())
+    except ValueError:
+        raise PlatformEnvError(
+            f"{source} must be an integer device count, got {value!r}"
+        ) from None
+
+
+def env_platform() -> Optional[str]:
+    return os.environ.get("POLYAXON_JAX_PLATFORM") or None
+
+
+def env_n_cpu() -> int:
+    """POLYAXON_NUM_CPU_DEVICES with the JAX_NUM_CPU_DEVICES fallback — the
+    same convention the executor forwards into gang workers, so in-process
+    and gang runs see the same device count from the same environment."""
+    for var in ("POLYAXON_NUM_CPU_DEVICES", "JAX_NUM_CPU_DEVICES"):
+        raw = os.environ.get(var)
+        if raw:
+            return parse_n_cpu(raw, var)
+    return 1
+
+
+def apply_platform(platform: str, n_cpu: int = 1) -> None:
+    """Select `platform` (provisioning `n_cpu` virtual devices when cpu)
+    via jax.config. Raises RuntimeError if the backend is already up with a
+    conflicting configuration — callers decide whether that is fatal."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_num_cpu_devices", int(n_cpu))
+    jax.config.update("jax_platforms", platform)
+
+
+def apply_platform_env() -> Optional[str]:
+    """Apply POLYAXON_JAX_PLATFORM / POLYAXON_NUM_CPU_DEVICES if set.
+    Returns the platform applied, or None when the env asks for nothing."""
+    platform = env_platform()
+    if not platform:
+        return None
+    apply_platform(platform, env_n_cpu())
+    return platform

@@ -1,0 +1,37 @@
+"""Regression: long runs on a multi-device mesh must not outrun the device.
+
+Unbounded async dispatch used to exhaust XLA's collective thread pool on the
+8-device CPU mesh and abort at an all-reduce rendezvous ("Expected 8 threads
+to join... only 7 arrived") after ~100 queued steps; Trainer.run now blocks
+on step N-K so at most K steps are in flight.
+"""
+
+from polyaxon_tpu.runtime.trainer import Trainer
+from polyaxon_tpu.schemas.run_kinds import (
+    V1DataSpec,
+    V1ModelSpec,
+    V1OptimizerSpec,
+    V1Program,
+    V1TrainSpec,
+)
+
+
+def test_long_run_on_8_device_mesh_does_not_deadlock():
+    program = V1Program(
+        model=V1ModelSpec(
+            name="mlp", config={"input_dim": 16, "num_classes": 4, "hidden": [8]}
+        ),
+        data=V1DataSpec(
+            name="synthetic",
+            batch_size=16,
+            config={"shape": [16], "num_classes": 4},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=1e-2),
+        # 200 steps with sparse logging: the exact shape that deadlocked —
+        # log_every=50 leaves long stretches with no host sync at all
+        train=V1TrainSpec(steps=200, log_every=50, precision="float32"),
+    )
+    trainer = Trainer(program, mesh_axes={"data": -1})
+    result = trainer.run()
+    assert result.history and result.history[-1]["step"] == 200
+    assert result.history[-1]["loss"] == result.history[-1]["loss"]  # not NaN
