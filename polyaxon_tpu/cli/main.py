@@ -181,9 +181,8 @@ def ops_metrics(uid):
 def ops_stop(uid):
     store = RunStore()
     uid = store.resolve(uid)
-    store.set_status(uid, V1Statuses.STOPPING)
-    store.set_status(uid, V1Statuses.STOPPED)
-    click.echo(f"{uid[:8]} stopped")
+    status = store.request_stop(uid)
+    click.echo(f"{uid[:8]} {status}")
 
 
 @cli.group()

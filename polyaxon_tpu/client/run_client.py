@@ -3,14 +3,15 @@
 Two transports behind one API:
 - local (default): directly over the file-backed run store — what the CLI,
   tuner, and tracking already use.
-- http: read-side against a streams service (streams/server.py) for remote
-  inspection; mutations stay local-only (the streams service is read-only
-  by design, like upstream's).
+- http: against the streams+control service (streams/server.py) — the full
+  CLI↔server contract (SURVEY.md §3 boundary #1): create/stop over POST,
+  logs/metrics/status/artifacts over GET.
 
-    client = RunClient()                       # local
-    client = RunClient(base_url="http://host:8585")   # remote reads
-    uuid = client.create(op)                   # local only
+    client = RunClient()                              # local
+    client = RunClient(base_url="http://host:8585")   # remote
+    uuid = client.create(op)                          # POST /runs
     client.logs(uuid); client.metrics(uuid); client.statuses(uuid)
+    client.stop(uuid)                                 # POST /runs/<id>/stop
 """
 
 from __future__ import annotations
@@ -41,6 +42,26 @@ class _HttpTransport:
         except urllib.error.URLError as e:
             raise ClientError(f"GET {path}: {e.reason}") from e
 
+    def post(self, path: str, body: Optional[dict] = None) -> Any:
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body or {}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = ": " + json.loads(e.read()).get("error", "")
+            except Exception:  # noqa: BLE001 — detail is best-effort
+                pass
+            raise ClientError(f"POST {path}: HTTP {e.code}{detail}") from e
+        except urllib.error.URLError as e:
+            raise ClientError(f"POST {path}: {e.reason}") from e
+
 
 class RunClient:
     def __init__(
@@ -63,7 +84,13 @@ class RunClient:
     def create(self, op: V1Operation, *, queue: bool = True) -> str:
         """Submit an operation. queue=True enqueues for an agent; False
         executes THIS run inline to completion (never an arbitrary queue
-        entry — another agent may own older queued work)."""
+        entry — another agent may own older queued work). Over HTTP, the
+        operation is POSTed to the control service, which enqueues it for
+        the agent draining that store (always queued)."""
+        if self._http:
+            return self._http.post(
+                "/runs", {"operation": op.to_dict(), "project": self.project}
+            )["uuid"]
         from ..scheduler.agent import Agent
 
         agent = Agent(store=self.store)
@@ -86,9 +113,10 @@ class RunClient:
         return uuid
 
     def stop(self, uuid: str):
-        uuid = self.store.resolve(uuid)
-        self.store.set_status(uuid, V1Statuses.STOPPING)
-        self.store.set_status(uuid, V1Statuses.STOPPED)
+        if self._http:
+            self._http.post(f"/runs/{uuid}/stop")
+            return
+        self.store.request_stop(self.store.resolve(uuid))
 
     # ---------------------------------------------------------------- read
     def _resolve(self, uuid: str) -> str:

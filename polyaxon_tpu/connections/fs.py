@@ -1,0 +1,150 @@
+"""Artifact-store data plane: put/get/list behind every artifact connection.
+
+Reference parity (SURVEY.md §2 "Connections/fs": S3/GCS/Azure/volumes with
+fsspec IO). TPU-first stance: on GKE TPU pods object storage arrives as a
+MOUNT (the gcsfuse CSI driver maps gs://bucket to a pod path), so one
+path-backed engine serves every connection kind:
+
+- host_path / volume_claim → the path itself is the store root.
+- bucket (s3://, gs://, wasb://) → `<object_root>/<bucket-host>/<prefix>`,
+  where object_root is the mount point (env POLYAXON_OBJECT_STORE_ROOT,
+  default `<POLYAXON_HOME>/object-store`). The data plane is therefore
+  byte-identical between a laptop run and an on-cluster gcsfuse mount; the
+  cloud SDKs this image lacks (zero egress) are not needed for either.
+
+Used by the executor's sidecar semantics (outputs upload after a run), the
+init semantics (artifact pull before a run), and tracking's log_artifact
+when a connection is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Optional
+from urllib.parse import urlparse
+
+from .schemas import V1Connection
+
+
+class ArtifactStoreError(Exception):
+    pass
+
+
+class ArtifactStore:
+    """Path-backed object store: keys are `/`-separated object names."""
+
+    def __init__(self, root: Path | str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _abs(self, key: str) -> Path:
+        target = (self.root / key.lstrip("/")).resolve()
+        root = self.root.resolve()
+        if target != root and root not in target.parents:
+            raise ArtifactStoreError(f"key {key!r} escapes the store root")
+        return target
+
+    # ------------------------------------------------------------- objects
+    def put(self, local: str | Path, key: str) -> str:
+        src = Path(local)
+        if not src.is_file():
+            raise ArtifactStoreError(f"not a file: {src}")
+        dst = self._abs(key)
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(src, dst)
+        return key
+
+    def get(self, key: str, local: str | Path) -> Path:
+        src = self._abs(key)
+        if not src.is_file():
+            raise ArtifactStoreError(f"no such object: {key!r}")
+        dst = Path(local)
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(src, dst)
+        return dst
+
+    def open(self, key: str, mode: str = "rb"):
+        if any(m in mode for m in ("w", "a", "+")):
+            target = self._abs(key)
+            target.parent.mkdir(parents=True, exist_ok=True)
+            return target.open(mode)
+        src = self._abs(key)
+        if not src.is_file():
+            raise ArtifactStoreError(f"no such object: {key!r}")
+        return src.open(mode)
+
+    def exists(self, key: str) -> bool:
+        return self._abs(key).is_file()
+
+    def delete(self, key: str) -> None:
+        target = self._abs(key)
+        if target.is_file():
+            target.unlink()
+        elif target.is_dir():
+            shutil.rmtree(target)
+
+    def list(self, prefix: str = "") -> list[str]:
+        base = self._abs(prefix) if prefix else self.root
+        if not base.exists():
+            return []
+        if base.is_file():
+            return [prefix]
+        return sorted(
+            str(p.relative_to(self.root)) for p in base.rglob("*") if p.is_file()
+        )
+
+    # --------------------------------------------------------------- trees
+    def put_tree(self, local_dir: str | Path, prefix: str) -> list[str]:
+        src = Path(local_dir)
+        if not src.is_dir():
+            raise ArtifactStoreError(f"not a directory: {src}")
+        keys = []
+        for p in sorted(src.rglob("*")):
+            if p.is_file():
+                keys.append(self.put(p, f"{prefix}/{p.relative_to(src)}"))
+        return keys
+
+    def get_tree(self, prefix: str, local_dir: str | Path) -> list[Path]:
+        dst = Path(local_dir)
+        out = []
+        for key in self.list(prefix):
+            rel = key[len(prefix):].lstrip("/") if prefix else key
+            out.append(self.get(key, dst / rel))
+        return out
+
+
+def default_object_root() -> Path:
+    env = os.environ.get("POLYAXON_OBJECT_STORE_ROOT")
+    if env:
+        return Path(env)
+    home = os.environ.get("POLYAXON_HOME", str(Path.home() / ".polyaxon"))
+    return Path(home) / "object-store"
+
+
+def build_artifact_store(
+    conn: V1Connection, object_root: Optional[Path | str] = None
+) -> ArtifactStore:
+    """Connection → data plane. Bucket schemes map under the object root
+    (the gcsfuse-style mount point); path kinds use their own path."""
+    spec = conn.spec
+    if spec.kind in ("host_path",):
+        return ArtifactStore(spec.host_path)
+    if spec.kind == "volume_claim":
+        # locally a claim is a directory under the object root named for it
+        root = Path(object_root or default_object_root()) / spec.volume_claim
+        return ArtifactStore(root)
+    if spec.kind == "bucket":
+        parsed = urlparse(spec.bucket)
+        if not parsed.scheme or not parsed.netloc:
+            raise ArtifactStoreError(
+                f"bucket must look like s3://name or gs://name, got {spec.bucket!r}"
+            )
+        root = Path(object_root or default_object_root()) / parsed.netloc
+        if parsed.path.strip("/"):
+            root = root / parsed.path.strip("/")
+        return ArtifactStore(root)
+    raise ArtifactStoreError(
+        f"connection kind {spec.kind!r} is not an artifact store"
+    )

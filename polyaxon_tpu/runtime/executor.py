@@ -29,10 +29,24 @@ class ExecutionError(Exception):
     pass
 
 
+class StopRequested(Exception):
+    """Raised inside the run body when a stop arrived (remote POST /stop or
+    `polyaxon ops stop`) — observed at log points, the executor's
+    cooperative cancellation boundary."""
+
+
 class Executor:
-    def __init__(self, store: Optional[RunStore] = None, devices: Optional[list] = None):
+    def __init__(
+        self,
+        store: Optional[RunStore] = None,
+        devices: Optional[list] = None,
+        catalog=None,
+    ):
+        from ..connections.schemas import ConnectionCatalog
+
         self.store = store or RunStore()
         self.devices = devices
+        self.catalog = catalog if catalog is not None else ConnectionCatalog()
 
     def execute(self, compiled: CompiledOperation) -> str:
         """Run to completion; returns final status. Retries per termination
@@ -78,14 +92,17 @@ class Executor:
             store.set_status(run_uuid, V1Statuses.STARTING)
             try:
                 self._run_once(compiled, timeout=timeout, resume=attempt > 0)
+                if self._stopped(run_uuid):  # stop raced the finish line
+                    return V1Statuses.STOPPED
                 store.set_status(run_uuid, V1Statuses.SUCCEEDED)
                 self._run_hooks(compiled, V1Statuses.SUCCEEDED)
                 return V1Statuses.SUCCEEDED
             except BaseException as e:  # noqa: BLE001 — record, then decide
                 store.append_log(run_uuid, f"ERROR: {e}\n{traceback.format_exc()}")
+                if isinstance(e, StopRequested) or self._stopped(run_uuid):
+                    return V1Statuses.STOPPED
                 if isinstance(e, KeyboardInterrupt):
-                    store.set_status(run_uuid, V1Statuses.STOPPING)
-                    store.set_status(run_uuid, V1Statuses.STOPPED)
+                    store.request_stop(run_uuid)
                     raise
                 if attempt < max_retries:
                     attempt += 1
@@ -98,6 +115,14 @@ class Executor:
                 )
                 self._run_hooks(compiled, V1Statuses.FAILED)
                 return V1Statuses.FAILED
+
+    def _stopped(self, run_uuid: str) -> bool:
+        """True when a stop request landed; settles STOPPING → STOPPED."""
+        current = self.store.get_status(run_uuid).get("status")
+        if current == V1Statuses.STOPPING:
+            self.store.set_status(run_uuid, V1Statuses.STOPPED)
+            return True
+        return current == V1Statuses.STOPPED
 
     # ------------------------------------------------------------------ hooks
     def _run_hooks(self, compiled: CompiledOperation, status: str) -> None:
@@ -214,17 +239,238 @@ class Executor:
         run = compiled.run
         run_uuid = compiled.run_uuid
         store = self.store
-        if run.kind == "jaxjob" and run.program is not None:
-            self._run_program(compiled, resume=resume)
-        elif run.kind in ("job", "jaxjob", "service") and run.container is not None:
-            self._run_container(compiled, timeout=timeout)
-        elif run.kind == "dag":
-            from ..scheduler.dag import execute_dag
+        # init semantics (SURVEY.md §3 stack (a): init container provisions
+        # the context dir before the main work starts)
+        if getattr(run, "init", None):
+            self._run_init(compiled)
+        sidecars = self._start_sidecars(compiled)
+        body_exc: Optional[BaseException] = None
+        try:
+            if run.kind == "jaxjob" and run.program is not None:
+                self._run_program(compiled, resume=resume)
+            elif run.kind in ("job", "jaxjob", "service") and run.container is not None:
+                self._run_container(compiled, timeout=timeout)
+            elif run.kind == "dag":
+                from ..scheduler.dag import execute_dag
 
-            store.set_status(run_uuid, V1Statuses.RUNNING)
-            execute_dag(compiled, self)
-        else:
-            raise ExecutionError(f"cannot execute run kind {run.kind!r} locally")
+                store.set_status(run_uuid, V1Statuses.RUNNING)
+                execute_dag(compiled, self)
+            else:
+                raise ExecutionError(f"cannot execute run kind {run.kind!r} locally")
+        except BaseException as e:
+            body_exc = e
+            raise
+        finally:
+            # aux failures must never mask the run's real failure; when the
+            # run itself succeeded, a failed outputs upload IS the failure
+            # (results that never reached the store don't exist)
+            try:
+                self._stop_sidecars(compiled, sidecars)
+            except Exception as e:  # noqa: BLE001
+                store.append_log(run_uuid, f"sidecar teardown failed: {e}")
+            try:
+                # sidecar semantics: outputs sync to the run's artifact
+                # store happens win or lose, like upstream's upload sidecar
+                self._sync_outputs(compiled)
+            except Exception as e:  # noqa: BLE001
+                store.append_log(run_uuid, f"outputs sync failed: {e}")
+                if body_exc is None:
+                    raise ExecutionError(f"outputs sync failed: {e}") from e
+
+    # ------------------------------------------------------------- init/aux
+    def context_dir(self, run_uuid: str):
+        d = self.store.run_dir(run_uuid) / "context"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _run_init(self, compiled: CompiledOperation):
+        """Execute every V1Init entry into the run's context dir: git clone,
+        artifact pull (connection store or another run's outputs), literal
+        files, host paths, or a custom container. Init failure fails the
+        run (same as an init-container crash on k8s)."""
+        import shutil
+        from pathlib import Path
+
+        run, store, run_uuid = compiled.run, self.store, compiled.run_uuid
+        ctx = self.context_dir(run_uuid)
+        for i, init in enumerate(run.init or []):
+            try:
+                if init.git:
+                    self._init_git(init, ctx, run_uuid)
+                if init.artifacts:
+                    self._init_artifacts(compiled, init, ctx)
+                if init.file:
+                    f = init.file
+                    dst = ctx / str(f.get("name") or f.get("path") or "file")
+                    dst.parent.mkdir(parents=True, exist_ok=True)
+                    dst.write_text(str(f.get("content", "")))
+                for p in init.paths or ():
+                    src = Path(p)
+                    dst = ctx / src.name
+                    if src.is_dir():
+                        shutil.copytree(src, dst, dirs_exist_ok=True)
+                    elif src.is_file():
+                        dst.parent.mkdir(parents=True, exist_ok=True)
+                        shutil.copy2(src, dst)
+                    else:
+                        raise ExecutionError(f"init path not found: {p}")
+                if init.container:
+                    self._run_aux_container(
+                        compiled, init.container, cwd=str(ctx), tag="init"
+                    )
+            except ExecutionError:
+                raise
+            except Exception as e:  # noqa: BLE001 — wrap with which entry failed
+                raise ExecutionError(f"init[{i}] failed: {e}") from e
+            store.append_log(run_uuid, f"init[{i}] done")
+
+    def _init_git(self, init, ctx, run_uuid):
+        git = init.git
+        url = str(git.get("url", ""))
+        dest = ctx / (git.get("dest") or url.rstrip("/").split("/")[-1].removesuffix(".git") or "repo")
+        cmd = ["git", "clone", "--quiet", url, str(dest)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise ExecutionError(f"git clone {url}: {proc.stderr.strip()}")
+        if git.get("revision"):
+            proc = subprocess.run(
+                ["git", "-C", str(dest), "checkout", "--quiet", str(git["revision"])],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                raise ExecutionError(
+                    f"git checkout {git['revision']}: {proc.stderr.strip()}"
+                )
+        self.store.append_log(run_uuid, f"init: cloned {url} -> {dest.name}")
+
+    def _init_artifacts(self, compiled, init, ctx):
+        """Pull artifacts into the context: from a named connection's store
+        (init.connection) or from another run's outputs ({'run': uuid})."""
+        from ..connections.fs import build_artifact_store
+
+        art = init.artifacts
+        if art.get("run"):
+            src_uuid = self.store.resolve(str(art["run"]))
+            src = self.store.outputs_dir(src_uuid)
+            import shutil
+
+            names = list(art.get("files") or []) + list(art.get("dirs") or [])
+            for name in names or [""]:
+                s = src / name if name else src
+                d = ctx / (name or src_uuid[:8])
+                if s.is_dir():
+                    shutil.copytree(s, d, dirs_exist_ok=True)
+                elif s.is_file():
+                    d.parent.mkdir(parents=True, exist_ok=True)
+                    shutil.copy2(s, d)
+                else:
+                    raise ExecutionError(f"run {src_uuid[:8]} has no output {name!r}")
+            return
+        if not init.connection:
+            raise ExecutionError("init.artifacts needs 'run' or a connection")
+        astore = build_artifact_store(self.catalog.get(init.connection))
+        for key in art.get("files") or ():
+            astore.get(key, ctx / key)
+        for prefix in art.get("dirs") or ():
+            astore.get_tree(prefix, ctx / prefix)
+
+    def _start_sidecars(self, compiled: CompiledOperation) -> list:
+        """Custom sidecar containers run alongside the main work as local
+        subprocesses; a drain thread streams each one's output into the run
+        log live (an undrained pipe would block the sidecar after ~64KB).
+        They are terminated when the run finishes."""
+        import threading
+
+        run = compiled.run
+        procs = []
+        for c in getattr(run, "sidecars", None) or []:
+            cmd = list(c.command or []) + list(c.args or [])
+            if not cmd:
+                continue
+            env = self._container_env(compiled, c)
+            proc = subprocess.Popen(
+                cmd,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=c.working_dir or None,
+                env=env,
+            )
+
+            def _drain(p=proc):
+                for line in iter(p.stdout.readline, ""):
+                    self.store.append_log(
+                        compiled.run_uuid, "[sidecar] " + line.rstrip("\n")
+                    )
+
+            t = threading.Thread(target=_drain, daemon=True)
+            t.start()
+            procs.append((proc, t))
+        return procs
+
+    def _stop_sidecars(self, compiled: CompiledOperation, procs: list):
+        for proc, drain in procs:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            drain.join(timeout=5)
+
+    def _sync_outputs(self, compiled: CompiledOperation):
+        """Upload the run's outputs tree to its artifact-store connection
+        (first artifact store named in run.connections). No connection → the
+        local outputs dir IS the store; nothing to do."""
+        run = compiled.run
+        names = getattr(run, "connections", None) or []
+        store, run_uuid = self.store, compiled.run_uuid
+        for name in names:
+            conn = self.catalog.get(name)  # unknown name = config error
+            if not conn.is_artifact_store:
+                continue
+            from ..connections.fs import build_artifact_store
+
+            astore = build_artifact_store(conn)
+            prefix = f"{compiled.project}/{run_uuid}/outputs"
+            keys = astore.put_tree(store.outputs_dir(run_uuid), prefix)
+            store.log_event(
+                run_uuid,
+                "outputs_uploaded",
+                {"connection": name, "prefix": prefix, "files": len(keys)},
+            )
+            store.append_log(
+                run_uuid, f"sidecar: uploaded {len(keys)} outputs to {name}:{prefix}"
+            )
+            return
+
+    def _container_env(self, compiled, c) -> dict[str, str]:
+        """Process env for any container: inherited + run-context vars +
+        the container's own env (dict or k8s list form)."""
+        env = dict(os.environ)
+        env.update(_context_env(compiled, self.store))
+        if isinstance(c.env, dict):
+            env.update({k: str(v) for k, v in c.env.items()})
+        elif isinstance(c.env, list):
+            env.update({e["name"]: str(e.get("value", "")) for e in c.env})
+        return env
+
+    def _run_aux_container(self, compiled, c, cwd: str, tag: str):
+        cmd = list(c.command or []) + list(c.args or [])
+        if not cmd:
+            raise ExecutionError(f"{tag} container has no command")
+        env = self._container_env(compiled, c)
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, cwd=c.working_dir or cwd, env=env
+        )
+        for line in (proc.stdout or "").splitlines():
+            self.store.append_log(compiled.run_uuid, f"[{tag}] " + line)
+        if proc.returncode != 0:
+            raise ExecutionError(
+                f"{tag} container exited with code {proc.returncode}: "
+                f"{(proc.stderr or '').strip()[-500:]}"
+            )
 
     def _run_program(self, compiled: CompiledOperation, resume: bool):
         from .trainer import Trainer
@@ -258,6 +504,10 @@ class Executor:
                 f"{k}={v:.6g}" for k, v in metrics.items()
             )
             store.append_log(run_uuid, line)
+            # log points are the cooperative cancellation boundary
+            status = store.get_status(run_uuid).get("status")
+            if status in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+                raise StopRequested(f"stop requested at step {step}")
 
         trainer = Trainer(
             program,
@@ -359,12 +609,7 @@ class Executor:
         cmd = list(c.command or []) + list(c.args or [])
         if not cmd:
             raise ExecutionError("container has no command")
-        env = dict(os.environ)
-        env.update(_context_env(compiled, store))
-        if isinstance(c.env, dict):
-            env.update({k: str(v) for k, v in c.env.items()})
-        elif isinstance(c.env, list):
-            env.update({e["name"]: str(e.get("value", "")) for e in c.env})
+        env = self._container_env(compiled, c)
         store.set_status(run_uuid, V1Statuses.RUNNING)
         proc = subprocess.Popen(
             cmd,
@@ -393,5 +638,6 @@ def _context_env(compiled: CompiledOperation, store: RunStore) -> dict[str, str]
         "POLYAXON_RUN_NAME": compiled.name,
         "POLYAXON_PROJECT": compiled.project,
         "POLYAXON_RUN_OUTPUTS_PATH": str(store.outputs_dir(compiled.run_uuid)),
+        "POLYAXON_RUN_CONTEXT_PATH": str(store.run_dir(compiled.run_uuid) / "context"),
         "POLYAXON_HOME": str(store.home),
     }

@@ -32,10 +32,11 @@ class Agent:
         queue: Optional[RunQueue] = None,
         submit_fn: Optional[Callable[[CompiledOperation], str]] = None,
         devices: Optional[list] = None,
+        catalog=None,
     ):
         self.store = store or RunStore()
         self.queue = queue or RunQueue(self.store)
-        self.executor = Executor(store=self.store, devices=devices)
+        self.executor = Executor(store=self.store, devices=devices, catalog=catalog)
         self.submit_fn = submit_fn
 
     def submit(self, op: V1Operation, *, project: str = "default", priority: int = 0) -> str:
@@ -69,6 +70,12 @@ class Agent:
         return compiled.run_uuid
 
     def _process(self, entry: dict) -> str:
+        from ..schemas.lifecycle import DONE_STATUSES
+
+        # a remote client may have stopped the run while it sat in the queue
+        current = self.store.get_status(entry["uuid"]).get("status")
+        if current in {str(s) for s in DONE_STATUSES}:
+            return current
         op = V1Operation.model_validate(entry["payload"]["operation"])
         compiled = compile_operation(
             op,

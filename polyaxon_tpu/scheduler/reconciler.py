@@ -1,0 +1,174 @@
+"""Status reconciliation: cluster object status → run conditions.
+
+This is the reference operator's core duty rebuilt (SURVEY.md §3 stack (d):
+"operator reconcile → pod conditions → CRD status → agent → db"). The agent
+submits rendered manifests through a ClusterClient; the Reconciler polls
+pod phases back out and drives the run's lifecycle in the store, including
+gang-failure restarts per the spec's termination.maxRetries.
+
+The ClusterClient is injectable (the sandbox has no kubectl/apiserver):
+tests drive a FakeCluster; a real deployment implements the same three
+methods over the k8s API.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Protocol
+
+from ..schemas.lifecycle import DONE_STATUSES, V1Statuses, can_transition
+from ..store.local import RunStore
+
+_ACTIVE = {
+    V1Statuses.QUEUED,
+    V1Statuses.SCHEDULED,
+    V1Statuses.STARTING,
+    V1Statuses.RUNNING,
+    V1Statuses.UNKNOWN,
+}
+
+
+class ClusterClient(Protocol):
+    def submit(self, run_uuid: str, manifests: list[dict]) -> None: ...
+
+    def status(self, run_uuid: str) -> dict:
+        """→ {"pods": [{"name": str, "phase": "Pending|Running|Succeeded|
+        Failed", "exit_code": int?}]}; unknown run → {"pods": []}."""
+        ...
+
+    def delete(self, run_uuid: str) -> None: ...
+
+
+class ClusterSubmitter:
+    """Agent `submit_fn`: render the compiled operation to k8s manifests,
+    hand them to the cluster, persist them for restart, mark SCHEDULED."""
+
+    def __init__(
+        self,
+        store: RunStore,
+        cluster: ClusterClient,
+        catalog=None,
+        namespace: str = "polyaxon",
+    ):
+        self.store = store
+        self.cluster = cluster
+        self.catalog = catalog
+        self.namespace = namespace
+
+    def __call__(self, compiled) -> str:
+        from ..k8s.converter import convert_operation
+
+        manifests = convert_operation(compiled, self.catalog)
+        path = self.store.run_dir(compiled.run_uuid) / "manifests.json"
+        path.write_text(json.dumps(manifests))
+        self.cluster.submit(compiled.run_uuid, manifests)
+        for s in (V1Statuses.SCHEDULED,):
+            current = V1Statuses(self.store.get_status(compiled.run_uuid)["status"])
+            if current != s and can_transition(current, s):
+                self.store.set_status(compiled.run_uuid, s)
+        return V1Statuses.SCHEDULED
+
+
+def aggregate_pods(pods: list[dict]) -> Optional[str]:
+    """Gang phase: any Failed → failed; all Succeeded → succeeded; any
+    Running → running; else None (nothing to conclude yet)."""
+    if not pods:
+        return None
+    phases = [p.get("phase") for p in pods]
+    if any(ph == "Failed" for ph in phases):
+        return V1Statuses.FAILED
+    if all(ph == "Succeeded" for ph in phases):
+        return V1Statuses.SUCCEEDED
+    if any(ph == "Running" for ph in phases):
+        return V1Statuses.RUNNING
+    return None
+
+
+class Reconciler:
+    def __init__(self, store: RunStore, cluster: ClusterClient):
+        self.store = store
+        self.cluster = cluster
+
+    # ------------------------------------------------------------ helpers
+    def _max_retries(self, run_uuid: str) -> int:
+        spec = self.store.read_spec(run_uuid) or {}
+        term = (spec.get("component") or {}).get("termination") or {}
+        return int(term.get("maxRetries") or 0)
+
+    def _attempts(self, run_uuid: str) -> int:
+        meta = self.store.get_status(run_uuid).get("meta", {})
+        return int(meta.get("cluster_attempts") or 0)
+
+    def _bump_attempts(self, run_uuid: str):
+        self.store.set_meta(run_uuid, cluster_attempts=self._attempts(run_uuid) + 1)
+
+    def _advance(self, run_uuid: str, target: V1Statuses, reason: str = ""):
+        """Walk legal intermediate states toward `target` (e.g. SCHEDULED
+        can't jump to SUCCEEDED without passing RUNNING)."""
+        ladder = {
+            V1Statuses.RUNNING: [V1Statuses.RUNNING],
+            V1Statuses.SUCCEEDED: [V1Statuses.RUNNING, V1Statuses.SUCCEEDED],
+            V1Statuses.FAILED: [V1Statuses.FAILED],
+        }[target]
+        for s in ladder:
+            current = V1Statuses(self.store.get_status(run_uuid)["status"])
+            if current == target:
+                return
+            if current != s and can_transition(current, s):
+                self.store.set_status(run_uuid, s, reason=reason)
+
+    # --------------------------------------------------------------- tick
+    def tick(self) -> list[tuple[str, str]]:
+        """One reconcile pass over every active cluster-submitted run.
+        Returns [(uuid, new_status)] for runs whose status changed."""
+        changes = []
+        for rec in self.store.list_runs():
+            uuid = rec["uuid"]
+            manifest_path = self.store.run_dir(uuid) / "manifests.json"
+            if not manifest_path.exists():
+                continue  # not a cluster run
+            current = V1Statuses(self.store.get_status(uuid)["status"])
+            if current not in _ACTIVE:
+                continue
+            agg = aggregate_pods(self.cluster.status(uuid).get("pods", []))
+            if agg is None or agg == current:
+                continue
+            if agg == V1Statuses.FAILED:
+                changes.append((uuid, self._handle_failure(uuid, manifest_path)))
+                continue
+            self._advance(uuid, agg, reason="reconciler")
+            changes.append((uuid, self.store.get_status(uuid)["status"]))
+        return changes
+
+    def _handle_failure(self, uuid: str, manifest_path) -> str:
+        """Gang restart per termination.maxRetries: delete the failed
+        objects, resubmit the persisted manifests, walk the lifecycle back
+        through RETRYING→QUEUED→SCHEDULED."""
+        attempts = self._attempts(uuid)
+        if attempts < self._max_retries(uuid):
+            self._bump_attempts(uuid)
+            self.cluster.delete(uuid)
+            for s in (V1Statuses.RETRYING, V1Statuses.QUEUED, V1Statuses.SCHEDULED):
+                current = V1Statuses(self.store.get_status(uuid)["status"])
+                if current != s and can_transition(current, s):
+                    self.store.set_status(
+                        uuid, s, reason=f"gang restart {attempts + 1}"
+                    )
+            self.cluster.submit(uuid, json.loads(manifest_path.read_text()))
+            return self.store.get_status(uuid)["status"]
+        self._advance(uuid, V1Statuses.FAILED, reason="pod failed")
+        return self.store.get_status(uuid)["status"]
+
+    def watch(self, poll_interval: float = 2.0, stop_when=lambda: False):
+        import time
+
+        while not stop_when():
+            self.tick()
+            if all(
+                V1Statuses(self.store.get_status(r["uuid"]).get("status"))
+                in DONE_STATUSES
+                for r in self.store.list_runs()
+                if (self.store.run_dir(r["uuid"]) / "manifests.json").exists()
+            ):
+                return
+            time.sleep(poll_interval)

@@ -107,6 +107,29 @@ class RunStore:
     def get_status(self, run_uuid: str) -> dict:
         return _read_json(self.run_dir(run_uuid) / "status.json") or {}
 
+    def set_meta(self, run_uuid: str, **entries):
+        """Merge keys into the run's status meta (attempt counters etc.)."""
+        path = self.run_dir(run_uuid) / "status.json"
+        data = _read_json(path)
+        if data is None:
+            raise KeyError(f"unknown run {run_uuid}")
+        data.setdefault("meta", {}).update(entries)
+        _write_json(path, data)
+
+    def request_stop(self, run_uuid: str) -> str:
+        """Lifecycle-aware stop: RUNNING goes through STOPPING, QUEUED and
+        other pre-run stages go straight to STOPPED, terminal runs are left
+        alone. Returns the resulting status."""
+        from ..schemas.lifecycle import DONE_STATUSES
+
+        current = V1Statuses(self.get_status(run_uuid)["status"])
+        if current in DONE_STATUSES:
+            return current
+        if can_transition(current, V1Statuses.STOPPING):
+            self.set_status(run_uuid, V1Statuses.STOPPING)
+        self.set_status(run_uuid, V1Statuses.STOPPED)
+        return V1Statuses.STOPPED
+
     # ----------------------------------------------------------- events
     def log_metrics(self, run_uuid: str, step: int, metrics: dict[str, float]):
         line = json.dumps({"step": step, "ts": time.time(), **metrics})

@@ -1,20 +1,27 @@
-"""Streams service: HTTP access to run logs/metrics/events/artifacts.
+"""Streams + control service: HTTP access to the run store.
 
-Reference parity (SURVEY.md §2 "Streams": an ASGI service tailing fs/k8s).
-Local rebuild: a dependency-free ThreadingHTTPServer over the run store —
-the same files the trainer/sidecar write. Endpoints:
+Reference parity (SURVEY.md §2 "Streams" + the write side of §3 boundary #1
+"CLI → API server"). Local rebuild: a dependency-free ThreadingHTTPServer
+over the run store — the same files the trainer/sidecar write. Endpoints:
 
-  GET /healthz
-  GET /runs                         → index (optionally ?project=)
-  GET /runs/<uuid>/status
-  GET /runs/<uuid>/logs[?offset=N]  → text; offset supports tail-follow
-  GET /runs/<uuid>/metrics
-  GET /runs/<uuid>/events
-  GET /runs/<uuid>/artifacts        → list outputs tree
-  GET /runs/<uuid>/artifacts/<path> → file download
+  GET  /healthz
+  GET  /runs                         → index (optionally ?project=)
+  GET  /runs/<uuid>/status
+  GET  /runs/<uuid>/logs[?offset=N]  → text; offset supports tail-follow
+  GET  /runs/<uuid>/metrics
+  GET  /runs/<uuid>/events
+  GET  /runs/<uuid>/artifacts        → list outputs tree
+  GET  /runs/<uuid>/artifacts/<path> → file download
+  POST /runs                         → create: {"operation": <V1Operation>,
+                                       "project": p} → compile + enqueue;
+                                       an agent draining the same store's
+                                       queue executes it
+  POST /runs/<uuid>/stop             → request stop
 
 `polyaxon streams start [--port P]` serves; the CLI's `ops logs --follow`
 polls the offset endpoint the same way upstream's CLI tails the stream ws.
+With the POST side, a remote `RunClient(base_url=...)` has the full
+create→watch→stop loop over the wire.
 """
 
 from __future__ import annotations
@@ -111,6 +118,49 @@ class _Handler(BaseHTTPRequestHandler):
             self._not_found(parsed.path)
         except KeyError as e:
             self._not_found(str(e))
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            self._send(500, _json_bytes({"error": str(e)}))
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        store = self.store
+        try:
+            if parts == ["runs"]:
+                body = self._read_body()
+                if "operation" not in body:
+                    return self._send(
+                        400, _json_bytes({"error": "body needs 'operation'"})
+                    )
+                from ..schemas.operation import V1Operation
+                from ..scheduler.agent import Agent
+
+                op = V1Operation.model_validate(body["operation"])
+                agent = Agent(store=store)  # enqueue-only here; a serving
+                # agent on this store drains and executes
+                uuid = agent.submit(
+                    op,
+                    project=body.get("project") or "default",
+                    priority=int(body.get("priority") or 0),
+                )
+                return self._send(201, _json_bytes({"uuid": uuid}))
+            if len(parts) == 3 and parts[0] == "runs" and parts[2] == "stop":
+                uuid = store.resolve(parts[1])
+                if not (store.run_dir(uuid) / "status.json").exists():
+                    return self._not_found(f"run {parts[1]}")
+                store.request_stop(uuid)
+                return self._send(200, _json_bytes(store.get_status(uuid)))
+            self._not_found(parsed.path)
+        except KeyError as e:
+            self._not_found(str(e))
+        except (ValueError, TypeError) as e:  # bad JSON / bad spec → 400
+            self._send(400, _json_bytes({"error": str(e)}))
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             self._send(500, _json_bytes({"error": str(e)}))
 

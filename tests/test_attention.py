@@ -65,6 +65,7 @@ def test_ring_matches_xla(causal):
         set_current_mesh(None)
 
 
+@pytest.mark.slow
 def test_ring_backward_matches_xla():
     mesh = build_mesh({"data": 2, "context": 4})
     set_current_mesh(mesh)
@@ -89,6 +90,7 @@ def test_ring_falls_back_without_context_axis():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_trainer_ring_attention_end_to_end():
     """Full train step with context parallelism: mesh {data:2, context:4},
     transformer with attention=ring — loss finite and sequence sharded."""

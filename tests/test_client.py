@@ -62,8 +62,8 @@ def test_run_client_http_transport(tmp_home, tmp_path):
         assert remote.get(uuid)["status"] == "succeeded"
         assert "out-line" in remote.logs(uuid)
         assert remote.list()[0]["uuid"] == uuid
-        with pytest.raises(ClientError):
-            remote.create(_op(tmp_path))  # mutations need local store
+        uuid2 = remote.create(_op(tmp_path))  # write side: POST /runs
+        assert remote.get(uuid2)["status"] == V1Statuses.QUEUED
 
 
 def test_project_client(tmp_home, tmp_path):
@@ -94,3 +94,90 @@ def test_settings_layering(tmp_path, monkeypatch):
         settings.get("nope")
     data = json.loads((tmp_path / "config.json").read_text())
     assert data == {"project": "from-file"}
+
+
+def test_http_write_side_end_to_end(tmp_home, tmp_path):
+    """SURVEY.md §3 boundary #1 over the wire: remote create → agent
+    executes → remote reads → remote stop of a queued run."""
+    import threading
+
+    from polyaxon_tpu.scheduler import Agent
+
+    store = RunStore()
+    with BackgroundServer(store) as srv:
+        remote = RunClient(base_url=f"http://127.0.0.1:{srv.port}")
+        uuid = remote.create(_op(tmp_path))
+        assert remote.get(uuid)["status"] == V1Statuses.QUEUED
+        t = threading.Thread(target=lambda: Agent(store=store).drain())
+        t.start()
+        status = remote.wait(uuid, timeout=60)
+        t.join()
+        assert status == V1Statuses.SUCCEEDED
+        assert "out-line" in remote.logs(uuid)
+
+        # stop a queued run remotely; the agent must then skip it
+        uuid2 = remote.create(_op(tmp_path))
+        remote.stop(uuid2)
+        assert remote.get(uuid2)["status"] == V1Statuses.STOPPED
+        Agent(store=store).drain()
+        assert remote.get(uuid2)["status"] == V1Statuses.STOPPED
+
+        # bad spec → 400 with detail, not a server crash
+        with pytest.raises(ClientError, match="400"):
+            remote._http.post("/runs", {"operation": {"kind": "nope"}})
+        with pytest.raises(ClientError, match="400"):
+            remote._http.post("/runs", {})
+
+
+def test_stop_while_running_cooperative(tmp_home, tmp_path):
+    """A stop landing mid-run halts training at the next log point and the
+    run ends STOPPED — not SUCCEEDED, and with no illegal-transition crash."""
+    import threading
+    import time
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "long",
+        "component": {
+            "kind": "component",
+            "name": "long",
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {
+                        "name": "mlp",
+                        "config": {"input_dim": 16, "num_classes": 4, "hidden": [8]},
+                    },
+                    "data": {
+                        "name": "synthetic",
+                        "batchSize": 8,
+                        "config": {"shape": [16], "num_classes": 4},
+                    },
+                    "train": {"steps": 2000, "logEvery": 1, "precision": "float32"},
+                },
+            },
+        },
+    }
+    client = RunClient()
+    results = {}
+
+    def _run():
+        results["uuid"] = client.create(_op(tmp_path, spec), queue=False)
+
+    t = threading.Thread(target=_run)
+    t.start()
+    deadline = time.time() + 60
+    uuid = None
+    while time.time() < deadline:
+        runs = client.list()
+        if runs and runs[0]["status"] == V1Statuses.RUNNING:
+            uuid = runs[0]["uuid"]
+            break
+        time.sleep(0.2)
+    assert uuid, "run never reached RUNNING"
+    client.stop(uuid)
+    t.join(timeout=60)
+    assert not t.is_alive(), "executor did not observe the stop"
+    assert results["uuid"] == uuid
+    assert client.get(uuid)["status"] == V1Statuses.STOPPED

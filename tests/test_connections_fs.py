@@ -1,0 +1,226 @@
+"""Artifact-store data plane + init/sidecar execution semantics
+(SURVEY.md §2 "Connections/fs", §1/§3 aux containers)."""
+
+import subprocess
+
+import pytest
+import yaml
+
+from polyaxon_tpu.compiler.resolver import compile_operation
+from polyaxon_tpu.connections.fs import (
+    ArtifactStore,
+    ArtifactStoreError,
+    build_artifact_store,
+)
+from polyaxon_tpu.connections.schemas import ConnectionCatalog, V1Connection
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.runtime.executor import Executor
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.store.local import RunStore
+
+
+# ------------------------------------------------------------------ data plane
+def test_artifact_store_round_trip(tmp_path):
+    store = ArtifactStore(tmp_path / "root")
+    src = tmp_path / "a.txt"
+    src.write_text("hello")
+    store.put(src, "exp/a.txt")
+    assert store.exists("exp/a.txt")
+    assert store.list("exp") == ["exp/a.txt"]
+    out = store.get("exp/a.txt", tmp_path / "back.txt")
+    assert out.read_text() == "hello"
+    with store.open("exp/b.bin", "wb") as f:
+        f.write(b"\x01\x02")
+    assert store.open("exp/b.bin").read() == b"\x01\x02"
+    store.delete("exp/a.txt")
+    assert not store.exists("exp/a.txt")
+
+
+def test_artifact_store_trees_and_escape(tmp_path):
+    store = ArtifactStore(tmp_path / "root")
+    d = tmp_path / "tree"
+    (d / "sub").mkdir(parents=True)
+    (d / "x.txt").write_text("x")
+    (d / "sub" / "y.txt").write_text("y")
+    keys = store.put_tree(d, "runs/u1/outputs")
+    assert sorted(keys) == ["runs/u1/outputs/sub/y.txt", "runs/u1/outputs/x.txt"]
+    got = store.get_tree("runs/u1/outputs", tmp_path / "out")
+    assert sorted(p.name for p in got) == ["x.txt", "y.txt"]
+    with pytest.raises(ArtifactStoreError):
+        store.put(d / "x.txt", "../../escape.txt")
+
+
+def test_bucket_connection_maps_under_object_root(tmp_path, monkeypatch):
+    monkeypatch.setenv("POLYAXON_OBJECT_STORE_ROOT", str(tmp_path / "obj"))
+    conn = V1Connection.model_validate(
+        {"name": "gcs", "spec": {"kind": "bucket", "bucket": "gs://my-bkt/pre"}}
+    )
+    store = build_artifact_store(conn)
+    assert store.root == tmp_path / "obj" / "my-bkt" / "pre"
+    with pytest.raises(ArtifactStoreError):
+        build_artifact_store(
+            V1Connection.model_validate(
+                {"name": "bad", "spec": {"kind": "bucket", "bucket": "not-a-url"}}
+            )
+        )
+
+
+# ------------------------------------------------------------- init semantics
+def _compile(tmp_path, spec):
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    return compile_operation(read_polyaxonfile(str(p)))
+
+
+def test_init_git_file_paths_and_sidecar_upload(tmp_home, tmp_path, monkeypatch):
+    monkeypatch.setenv("POLYAXON_OBJECT_STORE_ROOT", str(tmp_path / "obj"))
+    # a local git repo to clone (no network in this image)
+    repo = tmp_path / "srcrepo"
+    repo.mkdir()
+    (repo / "code.py").write_text("print('hi')\n")
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "."],
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "init"],
+    ):
+        subprocess.run(cmd, cwd=repo, check=True)
+    host_file = tmp_path / "datafile.bin"
+    host_file.write_bytes(b"\x00\x01")
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "aux",
+        "component": {
+            "kind": "component",
+            "name": "aux",
+            "run": {
+                "kind": "job",
+                "init": [
+                    {"git": {"url": str(repo)}},
+                    {"file": {"name": "cfg.json", "content": "{\"a\": 1}"}},
+                    {"paths": [str(host_file)]},
+                ],
+                "connections": ["gcs"],
+                "container": {
+                    "command": [
+                        "sh",
+                        "-c",
+                        'echo result > "$POLYAXON_RUN_OUTPUTS_PATH/result.txt"',
+                    ]
+                },
+            },
+        },
+    }
+    catalog = ConnectionCatalog.from_config(
+        [{"name": "gcs", "spec": {"kind": "bucket", "bucket": "gs://bkt"}}]
+    )
+    store = RunStore()
+    compiled = _compile(tmp_path, spec)
+    status = Executor(store, catalog=catalog).execute(compiled)
+    assert status == V1Statuses.SUCCEEDED
+
+    ctx = store.run_dir(compiled.run_uuid) / "context"
+    assert (ctx / "srcrepo" / "code.py").exists()  # git clone
+    assert (ctx / "cfg.json").read_text() == '{"a": 1}'  # literal file
+    assert (ctx / "datafile.bin").read_bytes() == b"\x00\x01"  # host path
+
+    # sidecar semantics: outputs landed in the bucket store
+    astore = build_artifact_store(catalog.get("gcs"))
+    key = f"default/{compiled.run_uuid}/outputs/result.txt"
+    assert astore.exists(key)
+    events = store.read_events(compiled.run_uuid)
+    assert any(e.get("kind") == "outputs_uploaded" for e in events)
+
+
+def test_init_failure_fails_run_with_context(tmp_home, tmp_path):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "bad-init",
+        "component": {
+            "kind": "component",
+            "name": "bad-init",
+            "run": {
+                "kind": "job",
+                "init": [{"paths": ["/definitely/not/a/path"]}],
+                "container": {"command": ["true"]},
+            },
+        },
+    }
+    store = RunStore()
+    compiled = _compile(tmp_path, spec)
+    status = Executor(store).execute(compiled)
+    assert status == V1Statuses.FAILED
+    assert "init path not found" in store.read_logs(compiled.run_uuid)
+
+
+def test_init_artifacts_from_previous_run(tmp_home, tmp_path):
+    """Run B pulls run A's outputs into its context — the restart/lineage
+    pattern (artifacts: {run: <uuid>})."""
+    store = RunStore()
+    spec_a = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "a",
+        "component": {
+            "kind": "component",
+            "name": "a",
+            "run": {
+                "kind": "job",
+                "container": {
+                    "command": [
+                        "sh",
+                        "-c",
+                        'echo model-weights > "$POLYAXON_RUN_OUTPUTS_PATH/w.txt"',
+                    ]
+                },
+            },
+        },
+    }
+    a = _compile(tmp_path, spec_a)
+    assert Executor(store).execute(a) == V1Statuses.SUCCEEDED
+
+    spec_b = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "b",
+        "component": {
+            "kind": "component",
+            "name": "b",
+            "run": {
+                "kind": "job",
+                "init": [{"artifacts": {"run": a.run_uuid, "files": ["w.txt"]}}],
+                "container": {"command": ["true"]},
+            },
+        },
+    }
+    b = _compile(tmp_path, spec_b)
+    assert Executor(store).execute(b) == V1Statuses.SUCCEEDED
+    ctx = store.run_dir(b.run_uuid) / "context"
+    assert (ctx / "w.txt").read_text().strip() == "model-weights"
+
+
+def test_sidecar_container_runs_alongside(tmp_home, tmp_path):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "sc",
+        "component": {
+            "kind": "component",
+            "name": "sc",
+            "run": {
+                "kind": "job",
+                "sidecars": [
+                    {"command": ["sh", "-c", "echo sidecar-alive; sleep 30"]}
+                ],
+                "container": {"command": ["sh", "-c", "sleep 0.3; echo main-done"]},
+            },
+        },
+    }
+    store = RunStore()
+    compiled = _compile(tmp_path, spec)
+    assert Executor(store).execute(compiled) == V1Statuses.SUCCEEDED
+    logs = store.read_logs(compiled.run_uuid)
+    assert "main-done" in logs
+    assert "[sidecar] sidecar-alive" in logs

@@ -89,7 +89,6 @@ def test_launcher_timeout():
     assert out.returncode == 124
 
 
-@pytest.mark.slow
 def test_distributed_jaxjob_end_to_end(tmp_home, tmp_path):
     """2-process gang, jax.distributed over CPU: executor spawns the gang via
     the native launcher, chief logs metrics, run succeeds."""

@@ -36,6 +36,7 @@ def test_registry_contents():
         assert required in names
 
 
+@pytest.mark.slow
 def test_transformer_trains_tp_fsdp_dp():
     trainer, result = _train(
         "transformer_lm",
@@ -55,6 +56,7 @@ def test_transformer_trains_tp_fsdp_dp():
     assert gate and gate[0] == ("fsdp", "model")
 
 
+@pytest.mark.slow
 def test_transformer_scan_layers_matches_param_count():
     plain = build_model("transformer_lm", {"preset": "tiny"})
     scanned = build_model("transformer_lm", {"preset": "tiny", "scan_layers": True})
@@ -66,6 +68,7 @@ def test_transformer_scan_layers_matches_param_count():
     assert n1 == n2
 
 
+@pytest.mark.slow
 def test_lora_freezes_base_params():
     trainer, result = _train(
         "transformer_lm",
@@ -101,6 +104,7 @@ def test_lora_freezes_base_params():
     assert np.abs(lora_b).max() > 0
 
 
+@pytest.mark.slow
 def test_resnet_batchnorm_stats_update():
     trainer, result = _train(
         "resnet",
@@ -117,6 +121,7 @@ def test_resnet_batchnorm_stats_update():
     assert np.abs(stem_mean).max() > 0  # moved off the zero init
 
 
+@pytest.mark.slow
 def test_vit_trains_and_descends():
     _, result = _train(
         "vit",
@@ -130,6 +135,7 @@ def test_vit_trains_and_descends():
     assert result.history[-1]["loss"] < 2.5  # well below ln(10)+slack
 
 
+@pytest.mark.slow
 def test_bert_mlm_loss_finite():
     _, result = _train(
         "bert",
@@ -150,6 +156,7 @@ def test_bad_preset_raises():
         build_model("resnet", {"depth": 42})
 
 
+@pytest.mark.slow
 def test_graft_entry():
     import sys
     sys.path.insert(0, "/root/repo")

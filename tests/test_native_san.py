@@ -1,0 +1,60 @@
+"""Sanitizer builds of the native gang supervisor (SURVEY.md §5 race
+detection): TSan and ASan+UBSan binaries must build and survive the
+stressful paths — gang teardown on partial failure and restart loops."""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "polyaxon_tpu" / "native"
+
+
+def _build(target: str) -> Path:
+    proc = subprocess.run(
+        ["make", "-C", str(NATIVE_DIR), target], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+    binary = NATIVE_DIR / f"polyaxon-launcher-{target}"
+    assert binary.exists()
+    return binary
+
+
+@pytest.mark.parametrize("san", ["tsan", "asan"])
+def test_sanitized_gang_restart_and_teardown(san):
+    binary = _build(san)
+    # restart loop: 2 workers, one fails fast, 2 restarts — exercises the
+    # fork/exec/waitpid/kill paths where a data race or UB would live
+    out = subprocess.run(
+        [
+            str(binary),
+            "--num-workers", "2",
+            "--max-restarts", "2",
+            "--", "/bin/sh", "-c",
+            'if [ "$JAX_PROCESS_ID" = 0 ]; then exit 7; else sleep 30; fi',
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 7, out.stderr
+    assert "WARNING" not in out.stderr, out.stderr  # sanitizer reports
+    assert "ERROR" not in out.stderr, out.stderr
+    events = [json.loads(l) for l in out.stdout.splitlines()]
+    assert [e["attempt"] for e in events if e["event"] == "gang_start"] == [0, 1, 2]
+    assert events[-1] == {"event": "gang_done", "code": 7}
+
+
+@pytest.mark.parametrize("san", ["tsan", "asan"])
+def test_sanitized_timeout_path(san):
+    binary = _build(san)
+    out = subprocess.run(
+        [str(binary), "--num-workers", "1", "--timeout", "1", "--",
+         "/bin/sh", "-c", "sleep 30"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 124, out.stderr
+    assert "WARNING" not in out.stderr and "ERROR" not in out.stderr, out.stderr

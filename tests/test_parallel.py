@@ -1,6 +1,7 @@
 """Expert-parallel MoE and pipeline-parallel tests on the virtual 8-device
 mesh — executing real shardings, not just rendering them (SURVEY.md §4)."""
 
+import pytest
 import jax
 import numpy as np
 
@@ -41,6 +42,7 @@ def _spec_of(shard_tree, fragment):
     raise AssertionError(f"no param matching {fragment!r}")
 
 
+@pytest.mark.slow
 def test_moe_trains_with_expert_axis():
     trainer = Trainer(_prog({"n_experts": 4}), mesh_axes={"data": 2, "expert": 4})
     result = trainer.run()
@@ -48,6 +50,7 @@ def test_moe_trains_with_expert_axis():
     assert _spec_of(trainer.p_shard, "gate_kernel")[0] == "expert"
 
 
+@pytest.mark.slow
 def test_moe_aux_loss_enters_total():
     """With a huge aux weight the loss must visibly exceed the pure-CE
     ceiling (ln 4096 ≈ 8.3), proving sown losses reach the objective."""
@@ -96,6 +99,7 @@ def test_pipeline_forward_matches_sequential():
         set_current_mesh(None)
 
 
+@pytest.mark.slow
 def test_pipeline_trains_with_stage_sharding():
     trainer = Trainer(
         _prog({"pipeline_stages": 4, "pipeline_microbatches": 4}),
@@ -106,6 +110,7 @@ def test_pipeline_trains_with_stage_sharding():
     assert _spec_of(trainer.p_shard, "gate_proj/kernel")[0] == "pipeline"
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_sequential():
     """GPipe backward (autodiff through ppermute) == sequential backward."""
     cfg = {

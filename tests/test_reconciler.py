@@ -1,0 +1,129 @@
+"""Reconciliation loop: fake-cluster pod phases drive run lifecycle —
+the reference operator's reconcile duty (SURVEY.md §3 stack (d))."""
+
+import yaml
+
+from polyaxon_tpu.connections.schemas import ConnectionCatalog
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.scheduler.agent import Agent
+from polyaxon_tpu.scheduler.reconciler import (
+    ClusterSubmitter,
+    Reconciler,
+    aggregate_pods,
+)
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.store.local import RunStore
+
+
+class FakeCluster:
+    """Dict-driven stand-in for the k8s API: tests mutate pod phases."""
+
+    def __init__(self):
+        self.submitted: dict[str, list[dict]] = {}
+        self.pods: dict[str, list[dict]] = {}
+        self.deleted: list[str] = []
+
+    def submit(self, run_uuid, manifests):
+        self.submitted[run_uuid] = manifests
+        # a fresh gang comes up Pending, one pod per job completion
+        job = next(m for m in manifests if m["kind"] == "Job")
+        n = int(job["spec"].get("completions") or 1)
+        self.pods[run_uuid] = [
+            {"name": f"w-{i}", "phase": "Pending"} for i in range(n)
+        ]
+
+    def status(self, run_uuid):
+        return {"pods": self.pods.get(run_uuid, [])}
+
+    def delete(self, run_uuid):
+        self.deleted.append(run_uuid)
+        self.pods.pop(run_uuid, None)
+
+    def set_all(self, run_uuid, phase):
+        for p in self.pods.get(run_uuid, []):
+            p["phase"] = phase
+
+
+SPEC = {
+    "version": 1.1,
+    "kind": "operation",
+    "name": "clusterjob",
+    "component": {
+        "kind": "component",
+        "name": "clusterjob",
+        "termination": {"maxRetries": 1},
+        "run": {
+            "kind": "jaxjob",
+            "replicas": 2,
+            "container": {"image": "img", "command": ["train"]},
+        },
+    },
+}
+
+
+def _submit(tmp_path, store, cluster):
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(SPEC))
+    op = read_polyaxonfile(str(p))
+    agent = Agent(
+        store=store,
+        submit_fn=ClusterSubmitter(store, cluster, ConnectionCatalog()),
+    )
+    uuid = agent.submit(op)
+    agent.drain()
+    return uuid
+
+
+def test_aggregate_pods():
+    assert aggregate_pods([]) is None
+    assert aggregate_pods([{"phase": "Pending"}]) is None
+    assert aggregate_pods([{"phase": "Running"}, {"phase": "Pending"}]) == V1Statuses.RUNNING
+    assert aggregate_pods([{"phase": "Succeeded"}] * 2) == V1Statuses.SUCCEEDED
+    assert (
+        aggregate_pods([{"phase": "Succeeded"}, {"phase": "Failed"}])
+        == V1Statuses.FAILED
+    )
+
+
+def test_pod_transitions_drive_lifecycle(tmp_home, tmp_path):
+    store, cluster = RunStore(), FakeCluster()
+    uuid = _submit(tmp_path, store, cluster)
+    assert store.get_status(uuid)["status"] == V1Statuses.SCHEDULED
+    assert uuid in cluster.submitted
+
+    rec = Reconciler(store, cluster)
+    assert rec.tick() == []  # all Pending: nothing to conclude
+
+    cluster.set_all(uuid, "Running")
+    assert rec.tick() == [(uuid, V1Statuses.RUNNING)]
+    assert store.get_status(uuid)["status"] == V1Statuses.RUNNING
+
+    cluster.set_all(uuid, "Succeeded")
+    assert rec.tick() == [(uuid, V1Statuses.SUCCEEDED)]
+    conds = [c["type"] for c in store.get_status(uuid)["conditions"]]
+    assert conds[-1] == "succeeded"
+    assert rec.tick() == []  # terminal: reconciler leaves it alone
+
+
+def test_gang_failure_restarts_then_fails(tmp_home, tmp_path):
+    store, cluster = RunStore(), FakeCluster()
+    uuid = _submit(tmp_path, store, cluster)
+    rec = Reconciler(store, cluster)
+
+    cluster.set_all(uuid, "Running")
+    rec.tick()
+    cluster.pods[uuid][0]["phase"] = "Failed"  # one worker dies
+
+    # maxRetries=1 → first failure: delete + resubmit, back to SCHEDULED
+    assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)]
+    assert cluster.deleted == [uuid]
+    assert all(p["phase"] == "Pending" for p in cluster.pods[uuid])
+    types = [c["type"] for c in store.get_status(uuid)["conditions"]]
+    assert "retrying" in types
+
+    # second failure exhausts retries → FAILED
+    cluster.set_all(uuid, "Running")
+    rec.tick()
+    cluster.set_all(uuid, "Failed")
+    assert rec.tick() == [(uuid, V1Statuses.FAILED)]
+    assert store.get_status(uuid)["status"] == V1Statuses.FAILED
