@@ -29,23 +29,10 @@ import subprocess
 import sys
 import time
 
-# peak bf16 matmul FLOPs per chip (public spec sheets)
-_PEAK_BF16 = [
-    ("v6", 918e12),  # Trillium
-    ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-]
-
-
 def _peak_flops(device_kind: str):
-    dk = device_kind.lower()
-    for key, val in _PEAK_BF16:
-        if key in dk:
-            return val
-    return None
+    from polyaxon_tpu.utils.tpu_info import peak_bf16_flops
+
+    return peak_bf16_flops(device_kind)
 
 
 def _acquire_device(retries: int = 4):

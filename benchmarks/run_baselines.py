@@ -1,0 +1,285 @@
+"""BASELINE.md harness: measure every north-star config on this machine.
+
+Runs each BASELINE config through the framework's own Trainer (the loop
+`polyaxon run` drives), times a warm re-run (compile excluded), and prints
+one JSON line per config plus a markdown table ready for BASELINE.md.
+
+  python benchmarks/run_baselines.py                 # all configs
+  python benchmarks/run_baselines.py resnet50 bert   # subset
+  python benchmarks/run_baselines.py --update-baseline  # rewrite BASELINE.md
+
+Sizes are chip-sized on TPU (the judged numbers) and tiny on CPU (harness
+smoke). Device kind and MFU (analytic FLOPs over peak bf16) are recorded so
+numbers are comparable across rounds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+def _peak(device_kind: str):
+    from polyaxon_tpu.utils.tpu_info import peak_bf16_flops
+
+    return peak_bf16_flops(device_kind)
+
+
+def _program(model, data, optimizer, train):
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec, V1ModelSpec, V1OptimizerSpec, V1Program, V1TrainSpec,
+    )
+
+    return V1Program(
+        model=V1ModelSpec(**model),
+        data=V1DataSpec(**data),
+        optimizer=V1OptimizerSpec(**optimizer),
+        train=V1TrainSpec(**train),
+    )
+
+
+def _configs(on_tpu: bool) -> dict:
+    """name → (program kwargs, unit, items_per_step fn, flops_per_item)."""
+    if on_tpu:
+        return {
+            "mnist_mlp": dict(
+                model={"name": "mlp", "config": {"hidden": [512, 256], "num_classes": 10, "input_dim": 784}},
+                data={"name": "mnist", "batch_size": 128},
+                optimizer={"name": "adamw", "learning_rate": 1e-3},
+                train={"steps": 100, "log_every": 100, "precision": "float32"},
+                unit="examples/sec", per_step=128, flops_per_item=None,
+            ),
+            "resnet50": dict(
+                model={"name": "resnet50", "config": {"num_classes": 1000}},
+                data={"name": "synthetic_imagenet", "batch_size": 128},
+                optimizer={"name": "sgd", "learning_rate": 0.1,
+                           "config": {"momentum": 0.9, "nesterov": True}},
+                train={"steps": 20, "log_every": 20, "precision": "mixed"},
+                unit="images/sec", per_step=128,
+                flops_per_item=3 * 4.09e9,  # fwd 4.09 GFLOP @224 + ~2x bwd
+            ),
+            "bert_base": dict(
+                model={"name": "bert", "config": {"preset": "bert-base", "seq_len": 128}},
+                data={"name": "synthetic_mlm", "batch_size": 64,
+                      "config": {"seq_len": 128, "vocab_size": 30522}},
+                optimizer={"name": "adamw", "learning_rate": 1e-4},
+                train={"steps": 30, "log_every": 30, "precision": "mixed"},
+                unit="tokens/sec", per_step=64 * 128,
+                flops_per_item=6 * 110e6,  # 6N per token, N≈110M
+            ),
+            "llama_lora": dict(
+                model={"name": "llama", "config": {
+                    "variant": "1b", "max_len": 1024,
+                    "lora": {"rank": 16, "alpha": 32,
+                             "targets": ["q_proj", "k_proj", "v_proj", "o_proj"]}}},
+                data={"name": "synthetic_text", "batch_size": 4,
+                      "config": {"seq_len": 1024, "vocab_size": 128256}},
+                optimizer={"name": "adamw", "learning_rate": 2e-4},
+                train={"steps": 10, "log_every": 10, "precision": "mixed",
+                       "remat": True},
+                unit="tokens/sec", per_step=4 * 1024,
+                flops_per_item=6 * 1.24e9,  # 6N per token, N≈1.24B (grads flow through the frozen base)
+            ),
+        }
+    # CPU smoke tier: prove the harness end-to-end in seconds
+    return {
+        "mnist_mlp": dict(
+            model={"name": "mlp", "config": {"hidden": [64], "num_classes": 10, "input_dim": 784}},
+            data={"name": "mnist", "batch_size": 32},
+            optimizer={"name": "adamw", "learning_rate": 1e-3},
+            train={"steps": 20, "log_every": 20, "precision": "float32"},
+            unit="examples/sec", per_step=32, flops_per_item=None,
+        ),
+        "resnet50": dict(
+            model={"name": "resnet50", "config": {"num_classes": 10}},
+            data={"name": "synthetic_imagenet", "batch_size": 4,
+                  "config": {"image_size": 64, "num_classes": 10}},
+            optimizer={"name": "sgd", "learning_rate": 0.1},
+            train={"steps": 3, "log_every": 3, "precision": "float32"},
+            unit="images/sec", per_step=4, flops_per_item=None,
+        ),
+        "bert_base": dict(
+            model={"name": "bert", "config": {"dim": 128, "n_layers": 2, "n_heads": 4,
+                                              "seq_len": 64, "vocab_size": 1024}},
+            data={"name": "synthetic_mlm", "batch_size": 8,
+                  "config": {"seq_len": 64, "vocab_size": 1024}},
+            optimizer={"name": "adamw", "learning_rate": 1e-4},
+            train={"steps": 5, "log_every": 5, "precision": "float32"},
+            unit="tokens/sec", per_step=8 * 64, flops_per_item=None,
+        ),
+        "llama_lora": dict(
+            model={"name": "llama", "config": {
+                "dim": 128, "n_layers": 2, "n_heads": 4, "n_kv_heads": 2,
+                "vocab_size": 1024, "seq_len": 128,
+                "lora": {"rank": 4, "alpha": 8, "targets": ["q_proj", "v_proj"]}}},
+            data={"name": "synthetic_text", "batch_size": 4,
+                  "config": {"seq_len": 128, "vocab_size": 1024}},
+            optimizer={"name": "adamw", "learning_rate": 2e-4},
+            train={"steps": 5, "log_every": 5, "precision": "float32"},
+            unit="tokens/sec", per_step=4 * 128, flops_per_item=None,
+        ),
+    }
+
+
+def bench_training(name: str, cfg: dict, device) -> dict:
+    from polyaxon_tpu.runtime.trainer import Trainer
+
+    program = _program(cfg["model"], cfg["data"], cfg["optimizer"], cfg["train"])
+    steps = cfg["train"]["steps"]
+    trainer = Trainer(program, devices=[device])
+    trainer.run()  # compile + warm
+    t0 = time.perf_counter()
+    result = trainer.run()
+    dt = time.perf_counter() - t0
+    rate = steps * cfg["per_step"] / dt
+    mfu = None
+    peak = _peak(device.device_kind)
+    if cfg["flops_per_item"] and peak:
+        mfu = round(cfg["flops_per_item"] * rate / peak, 4)
+    return {
+        "config": name,
+        "value": round(rate, 1),
+        "unit": cfg["unit"],
+        "mfu": mfu,
+        "device_kind": device.device_kind,
+        "final_loss": round(result.history[-1]["loss"], 4) if result.history else None,
+    }
+
+
+def bench_tuner(device, on_tpu: bool) -> dict:
+    """Polytune trials/hour: a ViT grid sweep (BASELINE config #4 shape)
+    driven by the sweep driver; wall-clock per completed trial."""
+    import os
+    import tempfile
+
+    os.environ.setdefault("POLYAXON_HOME", tempfile.mkdtemp(prefix="plx-bench-"))
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.tuner.driver import run_sweep
+
+    if on_tpu:
+        model_cfg = {"preset": "vit-s16", "num_classes": 1000}
+        data = {"name": "synthetic_imagenet", "batchSize": 64}
+        steps, n_trials = 10, 4
+    else:
+        model_cfg = {"dim": 64, "n_layers": 2, "n_heads": 4, "patch": 8,
+                     "image_size": 32, "num_classes": 10}
+        data = {"name": "synthetic_imagenet", "batchSize": 4,
+                "config": {"image_size": 32, "num_classes": 10}}
+        steps, n_trials = 2, 2
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "vit-sweep-bench",
+        "matrix": {"kind": "grid", "params": {"lr": {"kind": "choice", "value": [1e-3, 3e-4, 1e-4, 3e-3][:n_trials]}}},
+        "component": {
+            "kind": "component",
+            "name": "vit",
+            "inputs": [{"name": "lr", "type": "float", "value": 1e-3}],
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {"name": "vit", "config": model_cfg},
+                    "data": data,
+                    "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                    "train": {"steps": steps, "logEvery": steps, "precision": "mixed" if on_tpu else "float32"},
+                },
+            },
+        },
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        yaml.safe_dump(spec, f)
+        path = f.name
+    op = read_polyaxonfile(path)
+    t0 = time.perf_counter()
+    summary = run_sweep(op, devices=[device])
+    dt = time.perf_counter() - t0
+    done = len(summary.get("trials") or []) or n_trials
+    return {
+        "config": "polytune_vit_sweep",
+        "value": round(done / (dt / 3600.0), 1),
+        "unit": "trials/hour",
+        "mfu": None,
+        "device_kind": device.device_kind,
+        "final_loss": None,
+    }
+
+
+_BEGIN = "<!-- baselines:begin -->"
+_END = "<!-- baselines:end -->"
+
+
+def update_baseline_md(rows: list[dict]):
+    md = REPO / "BASELINE.md"
+    text = md.read_text()
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    table = [
+        "",
+        f"Measured by `benchmarks/run_baselines.py` on {stamp}:",
+        "",
+        "| Config | Value | Unit | MFU | Device | Final loss |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        table.append(
+            f"| {r['config']} | {r['value']:,} | {r['unit']} | "
+            f"{r['mfu'] if r['mfu'] is not None else '—'} | {r['device_kind']} | "
+            f"{r['final_loss'] if r['final_loss'] is not None else '—'} |"
+        )
+    block = _BEGIN + "\n" + "\n".join(table) + "\n" + _END
+    if _BEGIN in text:
+        pre = text.split(_BEGIN)[0]
+        post = text.split(_END)[1]
+        text = pre + block + post
+    else:
+        text = text.rstrip() + "\n\n## Measured numbers (this framework)\n\n" + block + "\n"
+    md.write_text(text)
+    print(f"updated {md}", file=sys.stderr)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("configs", nargs="*", help="subset of config names")
+    ap.add_argument("--update-baseline", action="store_true")
+    args = ap.parse_args()
+
+    from polyaxon_tpu.utils.jax_platform import apply_platform_env
+
+    try:
+        apply_platform_env()
+    except Exception as e:  # noqa: BLE001
+        print(f"baselines: ignoring platform env: {e}", file=sys.stderr)
+    import jax
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    configs = _configs(on_tpu)
+    wanted = args.configs or [*configs, "polytune"]
+
+    rows = []
+    for name in wanted:
+        t0 = time.perf_counter()
+        try:
+            if name in ("polytune", "polytune_vit_sweep"):
+                row = bench_tuner(device, on_tpu)
+            else:
+                row = bench_training(name, configs[name], device)
+        except Exception as e:  # noqa: BLE001 — one bad config never kills the sweep
+            row = {"config": name, "value": 0.0, "unit": "—", "mfu": None,
+                   "device_kind": device.device_kind, "final_loss": None,
+                   "error": f"{type(e).__name__}: {e}"}
+        row["wall_s"] = round(time.perf_counter() - t0, 1)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.update_baseline:
+        update_baseline_md(rows)
+
+
+if __name__ == "__main__":
+    main()

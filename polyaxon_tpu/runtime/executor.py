@@ -89,6 +89,8 @@ class Executor:
 
         attempt = 0
         while True:
+            if self._stopped(run_uuid):  # stop landed between attempts
+                return V1Statuses.STOPPED
             store.set_status(run_uuid, V1Statuses.STARTING)
             try:
                 self._run_once(compiled, timeout=timeout, resume=attempt > 0)
@@ -99,7 +101,10 @@ class Executor:
                 return V1Statuses.SUCCEEDED
             except BaseException as e:  # noqa: BLE001 — record, then decide
                 store.append_log(run_uuid, f"ERROR: {e}\n{traceback.format_exc()}")
-                if isinstance(e, StopRequested) or self._stopped(run_uuid):
+                if isinstance(e, StopRequested):
+                    self._stopped(run_uuid)  # settles STOPPING → STOPPED
+                    return V1Statuses.STOPPED
+                if self._stopped(run_uuid):
                     return V1Statuses.STOPPED
                 if isinstance(e, KeyboardInterrupt):
                     store.request_stop(run_uuid)

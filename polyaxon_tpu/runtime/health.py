@@ -1,0 +1,52 @@
+"""Slice health check: prove devices and ICI collectives work BEFORE a long
+run starts (SURVEY.md §5 failure detection; on preemptible v5e slices a
+half-alive gang otherwise burns a full queue slot before failing).
+
+A tiny all-reduce across every device is the strongest cheap signal: it
+exercises device liveness, HBM allocation, and the collective path in one
+jitted op. Workers run it right after `jax.distributed.initialize`; the
+chief logs the result as a run event.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class SliceHealthError(RuntimeError):
+    pass
+
+
+def check_slice(devices: Optional[list] = None, expected_devices: Optional[int] = None) -> dict:
+    """→ {"devices": n, "platform": ..., "all_reduce_ok": True, ...};
+    raises SliceHealthError on any failure."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        devices = devices if devices is not None else jax.devices()
+    except Exception as e:  # noqa: BLE001 — backend init is a health outcome
+        raise SliceHealthError(f"backend init failed: {e}") from e
+    n = len(devices)
+    if expected_devices is not None and n < expected_devices:
+        raise SliceHealthError(f"expected {expected_devices} devices, found {n}")
+    try:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(devices, ("d",))
+        x = jax.device_put(
+            jnp.ones((n,), jnp.float32),
+            NamedSharding(mesh, PartitionSpec("d")),
+        )
+        total = float(jnp.sum(x))  # cross-device reduction over the mesh
+    except Exception as e:  # noqa: BLE001
+        raise SliceHealthError(f"collective check failed: {e}") from e
+    if total != float(n):
+        raise SliceHealthError(f"all-reduce returned {total}, expected {n}")
+    return {
+        "devices": n,
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "all_reduce_ok": True,
+        "process_count": jax.process_count(),
+    }

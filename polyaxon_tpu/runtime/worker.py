@@ -41,6 +41,12 @@ def main() -> int:
             process_id=process_id,
         )
 
+    # fail fast, before the queue slot is spent: a half-alive slice must
+    # surface here, not as a hang inside the first training collective
+    from .health import check_slice
+
+    health = check_slice()
+
     with open(spec_path) as f:
         payload = json.load(f)
 
@@ -57,6 +63,7 @@ def main() -> int:
         from ..store.local import RunStore
 
         store = RunStore()
+        store.log_event(run_uuid, "slice_health", health)
 
         def log_fn(step: int, metrics: dict):
             store.log_metrics(run_uuid, step, metrics)

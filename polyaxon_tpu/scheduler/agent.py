@@ -73,8 +73,9 @@ class Agent:
         from ..schemas.lifecycle import DONE_STATUSES
 
         # a remote client may have stopped the run while it sat in the queue
+        # (str-enum: plain string membership matches the enum set)
         current = self.store.get_status(entry["uuid"]).get("status")
-        if current in {str(s) for s in DONE_STATUSES}:
+        if current in DONE_STATUSES:
             return current
         op = V1Operation.model_validate(entry["payload"]["operation"])
         compiled = compile_operation(

@@ -62,11 +62,18 @@ class ClusterSubmitter:
         path = self.store.run_dir(compiled.run_uuid) / "manifests.json"
         path.write_text(json.dumps(manifests))
         self.cluster.submit(compiled.run_uuid, manifests)
-        for s in (V1Statuses.SCHEDULED,):
-            current = V1Statuses(self.store.get_status(compiled.run_uuid)["status"])
-            if current != s and can_transition(current, s):
-                self.store.set_status(compiled.run_uuid, s)
+        current = V1Statuses(self.store.get_status(compiled.run_uuid)["status"])
+        if current != V1Statuses.SCHEDULED and can_transition(
+            current, V1Statuses.SCHEDULED
+        ):
+            self.store.set_status(compiled.run_uuid, V1Statuses.SCHEDULED)
         return V1Statuses.SCHEDULED
+
+
+# pod failure reasons that mean "the machine went away", not "the program
+# is wrong" — on preemptible TPU slices (v5e spot pods) these are routine
+# and must not burn the user's maxRetries budget
+PREEMPTION_REASONS = {"Preempted", "Evicted", "NodeShutdown", "Shutdown"}
 
 
 def aggregate_pods(pods: list[dict]) -> Optional[str]:
@@ -82,6 +89,14 @@ def aggregate_pods(pods: list[dict]) -> Optional[str]:
     if any(ph == "Running" for ph in phases):
         return V1Statuses.RUNNING
     return None
+
+
+def is_preemption(pods: list[dict]) -> bool:
+    """True when every failed pod failed for a preemption-class reason."""
+    failed = [p for p in pods if p.get("phase") == "Failed"]
+    return bool(failed) and all(
+        p.get("reason") in PREEMPTION_REASONS for p in failed
+    )
 
 
 class Reconciler:
@@ -128,32 +143,54 @@ class Reconciler:
             if not manifest_path.exists():
                 continue  # not a cluster run
             current = V1Statuses(self.store.get_status(uuid)["status"])
+            if current in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+                # stop propagation: tear down the gang, then settle the status
+                if self.cluster.status(uuid).get("pods"):
+                    self.cluster.delete(uuid)
+                if current == V1Statuses.STOPPING:
+                    self.store.set_status(uuid, V1Statuses.STOPPED, reason="reconciler")
+                    changes.append((uuid, V1Statuses.STOPPED))
+                continue
             if current not in _ACTIVE:
                 continue
-            agg = aggregate_pods(self.cluster.status(uuid).get("pods", []))
+            pods = self.cluster.status(uuid).get("pods", [])
+            agg = aggregate_pods(pods)
             if agg is None or agg == current:
                 continue
             if agg == V1Statuses.FAILED:
-                changes.append((uuid, self._handle_failure(uuid, manifest_path)))
+                changes.append(
+                    (
+                        uuid,
+                        self._handle_failure(
+                            uuid, manifest_path, preempted=is_preemption(pods)
+                        ),
+                    )
+                )
                 continue
             self._advance(uuid, agg, reason="reconciler")
             changes.append((uuid, self.store.get_status(uuid)["status"]))
         return changes
 
-    def _handle_failure(self, uuid: str, manifest_path) -> str:
+    def _handle_failure(self, uuid: str, manifest_path, preempted: bool = False) -> str:
         """Gang restart per termination.maxRetries: delete the failed
         objects, resubmit the persisted manifests, walk the lifecycle back
-        through RETRYING→QUEUED→SCHEDULED."""
+        through RETRYING→QUEUED→SCHEDULED. Preemptions (spot slice taken
+        away) always restart and never consume the retry budget — the run
+        resumes from its last checkpoint."""
         attempts = self._attempts(uuid)
-        if attempts < self._max_retries(uuid):
-            self._bump_attempts(uuid)
+        if preempted or attempts < self._max_retries(uuid):
+            if not preempted:
+                self._bump_attempts(uuid)
             self.cluster.delete(uuid)
+            reason = (
+                "preempted: rescheduling"
+                if preempted
+                else f"gang restart {attempts + 1}"
+            )
             for s in (V1Statuses.RETRYING, V1Statuses.QUEUED, V1Statuses.SCHEDULED):
                 current = V1Statuses(self.store.get_status(uuid)["status"])
                 if current != s and can_transition(current, s):
-                    self.store.set_status(
-                        uuid, s, reason=f"gang restart {attempts + 1}"
-                    )
+                    self.store.set_status(uuid, s, reason=reason)
             self.cluster.submit(uuid, json.loads(manifest_path.read_text()))
             return self.store.get_status(uuid)["status"]
         self._advance(uuid, V1Statuses.FAILED, reason="pod failed")

@@ -117,8 +117,10 @@ class RunStore:
         _write_json(path, data)
 
     def request_stop(self, run_uuid: str) -> str:
-        """Lifecycle-aware stop: RUNNING goes through STOPPING, QUEUED and
-        other pre-run stages go straight to STOPPED, terminal runs are left
+        """Lifecycle-aware stop: RUNNING goes to STOPPING and stays there —
+        whoever owns the process (executor at its next log point, reconciler
+        for cluster gangs) observes it and settles STOPPED. Pre-run stages
+        with no live process go straight to STOPPED. Terminal runs are left
         alone. Returns the resulting status."""
         from ..schemas.lifecycle import DONE_STATUSES
 
@@ -127,6 +129,7 @@ class RunStore:
             return current
         if can_transition(current, V1Statuses.STOPPING):
             self.set_status(run_uuid, V1Statuses.STOPPING)
+            return V1Statuses.STOPPING
         self.set_status(run_uuid, V1Statuses.STOPPED)
         return V1Statuses.STOPPED
 

@@ -159,13 +159,35 @@ class SweepDriver:
         scored = [t for t in trials if t.objective is not None]
         return max(scored, key=self._score) if scored else None
 
+    def _topology(self):
+        """ICI torus dims from the op's `environment.resources.tpu`, when
+        declared and matching the actual device count — sub-slices then tile
+        the physical grid instead of approximating by list order."""
+        from .placement import parse_topology
+
+        run = getattr(self.op.component, "run", None) if self.op.component else None
+        env = getattr(run, "environment", None)
+        res = getattr(env, "resources", None)
+        tpu = getattr(res, "tpu", None)
+        topo = parse_topology(tpu) if tpu is not None else None
+        if topo is None:
+            return None
+        import math
+
+        import jax
+
+        n = len(self.devices) if self.devices is not None else len(jax.devices())
+        return topo if math.prod(topo) == n else None
+
     # ------------------------------------------------------------------
     def _run_batch(
         self, batch: list[Suggestion], sweep_uuid: str, iteration: int
     ) -> list[tuple[Suggestion, TrialResult]]:
         concurrency = self.matrix.concurrency or 1
         slices = (
-            sub_slices(concurrency, self.devices) if concurrency > 1 else [self.devices]
+            sub_slices(concurrency, self.devices, topology=self._topology())
+            if concurrency > 1
+            else [self.devices]
         )
         concurrency = max(1, len(slices))
         if concurrency == 1:

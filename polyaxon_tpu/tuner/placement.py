@@ -1,23 +1,85 @@
 """Topology-aware trial placement: pack concurrent Polytune trials onto
 disjoint sub-slices of the device pool (BASELINE north star: trials ride
-ICI-local sub-slices, e.g. v5e-32 → 4 disjoint v5e-8 groups).
+ICI-local sub-slices, e.g. v5e-32 [4x8 torus] → 4 disjoint v5e-8 [2x4]
+groups).
 
-Legal sub-slice sizes are powers of the torus dims; we approximate with
-contiguous equal splits of the `mesh_utils`-ordered device list, which
-preserves ICI locality (device order follows physical coords), and refuse
-splits that would leave a trial with a non-divisor share."""
+With a known ICI torus (`tpu: {topology: 4x8}` in the operation's
+environment), trials get TRUE sub-grids: block shapes whose dims divide the
+torus dims, so every trial's collectives stay on its own ICI neighborhood
+and never cross another trial's wires. Without a topology, we fall back to
+contiguous equal splits of the `mesh_utils`-ordered device list (order
+follows physical coords, preserving locality)."""
 
 from __future__ import annotations
 
-from typing import Optional
+import itertools
+from typing import Optional, Sequence
 
 import jax
 
 
+def parse_topology(spec) -> Optional[tuple[int, ...]]:
+    """V1TpuSpec (or its `topology` string) → dim tuple, else None —
+    including malformed strings (callers fall back to list-order splits)."""
+    topo = getattr(spec, "topology", spec)
+    if not topo or not isinstance(topo, str):
+        return None
+    parts = topo.lower().split("x")
+    if not all(p.isdigit() and int(p) > 0 for p in parts):
+        return None
+    return tuple(int(p) for p in parts)
+
+
+def _divisors(n: int) -> list[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def choose_block_shape(
+    topology: Sequence[int], n_trials: int
+) -> tuple[int, ...]:
+    """Largest legal sub-grid shape that yields >= n_trials disjoint tiles.
+
+    Legal = every block dim divides its torus dim (blocks tile the torus).
+    Among shapes with the minimal sufficient tile count, prefer the most
+    balanced block (smallest max/min dim ratio) — balanced sub-tori have
+    the best bisection bandwidth for a trial's own collectives."""
+    if n_trials <= 0:
+        raise ValueError("n_trials must be positive")
+    best = None
+    for shape in itertools.product(*[_divisors(t) for t in topology]):
+        tiles = 1
+        for t, s in zip(topology, shape):
+            tiles *= t // s
+        if tiles < n_trials:
+            continue
+        balance = max(shape) / max(1, min(shape))
+        key = (tiles, balance, -min(shape))
+        if best is None or key < best[0]:
+            best = (key, shape)
+    if best is None:  # n_trials > chip count: every trial gets one chip
+        return tuple(1 for _ in topology)
+    return best[1]
+
+
+def _grid_blocks(topology: Sequence[int], block: Sequence[int]) -> list[list[tuple]]:
+    """Coordinate blocks tiling the torus, lexicographic tile order."""
+    ranges = [range(0, t, s) for t, s in zip(topology, block)]
+    blocks = []
+    for origin in itertools.product(*ranges):
+        coords = [
+            tuple(o + d for o, d in zip(origin, delta))
+            for delta in itertools.product(*[range(s) for s in block])
+        ]
+        blocks.append(coords)
+    return blocks
+
+
 def sub_slices(
-    n_trials: int, devices: Optional[list] = None
+    n_trials: int,
+    devices: Optional[list] = None,
+    topology: Optional[Sequence[int]] = None,
 ) -> list[list]:
-    """Partition devices into n_trials equal ICI-contiguous groups.
+    """Partition devices into up to n_trials disjoint ICI-local groups.
 
     Returns fewer groups than requested when devices don't divide: the
     caller then throttles trial concurrency to len(result)."""
@@ -25,6 +87,27 @@ def sub_slices(
     n = len(devices)
     if n_trials <= 0:
         raise ValueError("n_trials must be positive")
+
+    if topology is not None:
+        import math
+
+        if math.prod(topology) != n:
+            raise ValueError(
+                f"topology {tuple(topology)} names {math.prod(topology)} chips "
+                f"but {n} devices are available"
+            )
+        block = choose_block_shape(topology, n_trials)
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_device_mesh(tuple(topology), devices=devices)
+        except Exception:  # CPU/virtual devices: shape the flat list
+            import numpy as np
+
+            grid = np.array(devices, dtype=object).reshape(tuple(topology))
+        blocks = _grid_blocks(topology, block)[:n_trials]
+        return [[grid[c] for c in coords] for coords in blocks]
+
     group = max(1, n // n_trials)
     # keep groups equal-sized: drop the ragged tail trials, never split a
     # device between trials

@@ -146,3 +146,12 @@ def test_distributed_jaxjob_end_to_end(tmp_home, tmp_path):
         assert '"event":"gang_done","code":0' in logs
     finally:
         os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+
+def test_slice_health_check():
+    from polyaxon_tpu.runtime.health import SliceHealthError, check_slice
+
+    report = check_slice()
+    assert report["devices"] == 8 and report["all_reduce_ok"]
+    with pytest.raises(SliceHealthError, match="expected 16"):
+        check_slice(expected_devices=16)

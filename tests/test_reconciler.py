@@ -127,3 +127,47 @@ def test_gang_failure_restarts_then_fails(tmp_home, tmp_path):
     cluster.set_all(uuid, "Failed")
     assert rec.tick() == [(uuid, V1Statuses.FAILED)]
     assert store.get_status(uuid)["status"] == V1Statuses.FAILED
+
+
+def test_preemption_restarts_without_burning_retries(tmp_home, tmp_path):
+    """Spot-slice preemptions resubmit indefinitely and never consume the
+    maxRetries budget; a real crash afterwards still respects it."""
+    store, cluster = RunStore(), FakeCluster()
+    uuid = _submit(tmp_path, store, cluster)
+    rec = Reconciler(store, cluster)
+
+    for round_ in range(3):  # preempt three times: always rescheduled
+        cluster.set_all(uuid, "Running")
+        rec.tick()
+        for p in cluster.pods[uuid]:
+            p["phase"], p["reason"] = "Failed", "Preempted"
+        assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)], f"round {round_}"
+    meta = store.get_status(uuid).get("meta", {})
+    assert int(meta.get("cluster_attempts") or 0) == 0  # budget untouched
+
+    # a genuine crash consumes the single retry, then fails
+    cluster.set_all(uuid, "Running")
+    rec.tick()
+    cluster.pods[uuid][0].update(phase="Failed", reason="Error")
+    assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)]
+    cluster.set_all(uuid, "Running")
+    rec.tick()
+    cluster.pods[uuid][0].update(phase="Failed", reason="Error")
+    assert rec.tick() == [(uuid, V1Statuses.FAILED)]
+
+
+def test_stop_propagates_to_cluster(tmp_home, tmp_path):
+    """Stopping a cluster-submitted run tears the gang down and settles
+    STOPPING → STOPPED via the reconciler."""
+    store, cluster = RunStore(), FakeCluster()
+    uuid = _submit(tmp_path, store, cluster)
+    rec = Reconciler(store, cluster)
+    cluster.set_all(uuid, "Running")
+    rec.tick()
+    assert store.get_status(uuid)["status"] == V1Statuses.RUNNING
+
+    assert store.request_stop(uuid) == V1Statuses.STOPPING
+    assert rec.tick() == [(uuid, V1Statuses.STOPPED)]
+    assert cluster.deleted == [uuid]
+    assert store.get_status(uuid)["status"] == V1Statuses.STOPPED
+    assert rec.tick() == []  # idempotent once settled

@@ -278,3 +278,67 @@ def test_sweep_end_to_end_grid(tmp_home):
     assert result.best.params["lr"] == 0.05  # learning beats a frozen lr
     statuses = [t.status for t in result.trials]
     assert all(s == "succeeded" for s in statuses)
+
+
+# ------------------------------------------------------------- placement
+def test_choose_block_shape_north_star():
+    """v5e-32 is a 4x8 torus; 4 concurrent trials must each get a legal
+    2x4 (v5e-8) sub-grid — the BASELINE north-star packing."""
+    from polyaxon_tpu.tuner.placement import choose_block_shape
+
+    assert sorted(choose_block_shape((4, 8), 4)) == [2, 4]
+    assert choose_block_shape((4, 8), 1) == (4, 8)  # one trial: whole slice
+    assert choose_block_shape((4, 8), 32) == (1, 1)
+    assert choose_block_shape((4, 8), 100) == (1, 1)  # oversubscribed: 1 chip each
+    # 3 trials on 4x8: no exact 3-way tiling exists; smallest sufficient is 4
+    shape = choose_block_shape((4, 8), 3)
+    tiles = (4 // shape[0]) * (8 // shape[1])
+    assert tiles >= 3
+
+
+def test_sub_slices_topology_tiles_are_disjoint_and_legal():
+    import jax
+
+    from polyaxon_tpu.tuner.placement import sub_slices
+
+    devices = jax.devices()  # 8 virtual CPU devices, treat as a 2x4 torus
+    groups = sub_slices(4, devices, topology=(2, 4))
+    assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+    seen = {id(d) for g in groups for d in g}
+    assert len(seen) == 8  # disjoint, covers the slice
+
+    with pytest.raises(ValueError, match="topology"):
+        sub_slices(2, devices, topology=(4, 4))  # 16 chips claimed, 8 present
+
+
+def test_sweep_respects_declared_topology(tmp_home):
+    """Driver picks grid placement when environment.resources.tpu.topology
+    matches the device pool."""
+    import jax
+
+    from polyaxon_tpu.schemas.operation import V1Operation
+    from polyaxon_tpu.tuner.driver import SweepDriver
+
+    op = V1Operation.model_validate(
+        {
+            "name": "sweep",
+            "matrix": {
+                "kind": "grid",
+                "concurrency": 4,
+                "params": {"lr": {"kind": "choice", "value": [1, 2, 3, 4]}},
+            },
+            "component": {
+                "kind": "component",
+                "name": "c",
+                "run": {
+                    "kind": "job",
+                    "container": {"command": ["true"]},
+                    "environment": {
+                        "resources": {"tpu": {"type": "v5e", "topology": "2x4"}}
+                    },
+                },
+            },
+        }
+    )
+    driver = SweepDriver(op, devices=jax.devices())
+    assert driver._topology() == (2, 4)
