@@ -185,6 +185,45 @@ def ops_stop(uid):
     click.echo(f"{uid[:8]} {status}")
 
 
+def _clone_cmd(uid, kind, eager):
+    from ..client import ClientError, RunClient
+    from ..compiler.resolver import CompilationError
+
+    client = RunClient()
+    try:
+        new_uuid = getattr(client, kind)(uid, queue=not eager)
+    except (ClientError, CompilationError) as e:
+        raise click.ClickException(str(e))
+    except KeyError as e:  # unknown/ambiguous uid from store.resolve
+        raise click.ClickException(str(e).strip("'\""))
+    status = client.get(new_uuid).get("status", "queued")
+    click.echo(f"{kind} of {uid[:8]} -> run {new_uuid[:8]} ({status})")
+
+
+@ops.command("restart")
+@click.option("-uid", "--uid", required=True)
+@click.option("--eager/--queue", default=True, help="run now vs enqueue for an agent")
+def ops_restart(uid, eager):
+    """Fresh run from the source run's resolved spec."""
+    _clone_cmd(uid, "restart", eager)
+
+
+@ops.command("resume")
+@click.option("-uid", "--uid", required=True)
+@click.option("--eager/--queue", default=True)
+def ops_resume(uid, eager):
+    """Continue training from the source run's latest checkpoint."""
+    _clone_cmd(uid, "resume", eager)
+
+
+@ops.command("copy")
+@click.option("-uid", "--uid", required=True)
+@click.option("--eager/--queue", default=True)
+def ops_copy(uid, eager):
+    """New run seeded with a copy of the source outputs."""
+    _clone_cmd(uid, "copy", eager)
+
+
 @cli.group()
 def streams():
     """Log/metric/event/artifact streaming service."""
@@ -340,6 +379,53 @@ def admin_deploy(namespace, image, store_size, dry_run, out):
         return
     paths = write_deploy(manifests, out)
     click.echo(f"wrote {len(paths)} manifests to {out} (kubectl apply -f {out})")
+
+
+@admin.command("upgrade")
+@click.option("--namespace", default="polyaxon")
+@click.option("--image", required=True, help="new control-plane image")
+@click.option("--store-size", default="50Gi")
+@click.option("--out", default="deploy/", help="manifest dir to upgrade in place")
+def admin_upgrade(namespace, image, store_size, out):
+    """Re-render the control plane with a new image; state (the store PVC)
+    is untouched, so runs and queues survive the upgrade."""
+    import os as _os
+
+    from ..k8s.deploy import render_deploy, write_deploy
+
+    if not _os.path.isdir(out):
+        raise click.ClickException(
+            f"{out} does not exist — `polyaxon admin deploy` first"
+        )
+    manifests = render_deploy(namespace=namespace, image=image, store_size=store_size)
+    paths = write_deploy(manifests, out)
+    click.echo(
+        f"re-rendered {len(paths)} manifests with image {image} "
+        f"(kubectl apply -f {out} performs a rolling update; PVC unchanged)"
+    )
+
+
+@admin.command("teardown")
+@click.option("--namespace", default="polyaxon")
+@click.option("--keep-store/--delete-store", default=True,
+              help="keep the run-store PVC (default) or delete it too")
+def admin_teardown(namespace, keep_store):
+    """Print the teardown commands (services first, store last — and only
+    with --delete-store; run data is not deletable by default)."""
+    cmds = [
+        f"kubectl -n {namespace} delete deployment polyaxon-agent polyaxon-streams",
+        f"kubectl -n {namespace} delete service polyaxon-streams",
+    ]
+    if not keep_store:
+        cmds.append(f"kubectl -n {namespace} delete pvc polyaxon-store")
+        cmds.append(f"kubectl delete namespace {namespace}")
+    for c in cmds:
+        click.echo(c)
+    if keep_store:
+        click.echo(
+            f"# run store kept: pvc/polyaxon-store in {namespace} "
+            "(re-deploy reattaches it)"
+        )
 
 
 def main():

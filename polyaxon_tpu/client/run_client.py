@@ -96,20 +96,7 @@ class RunClient:
         agent = Agent(store=self.store)
         uuid = agent.submit(op, project=self.project)
         if not queue:
-            entry = None
-            remaining = []
-            while True:
-                e = agent.queue.pop()
-                if e is None:
-                    break
-                if e["uuid"] == uuid:
-                    entry = e
-                    break
-                remaining.append(e)
-            for e in remaining:  # put back what belongs to others
-                agent.queue.push(e["uuid"], e["payload"], e.get("priority", 0))
-            if entry is not None:
-                agent._process(entry)
+            self._run_inline(agent, uuid)
         return uuid
 
     def stop(self, uuid: str):
@@ -117,6 +104,119 @@ class RunClient:
             self._http.post(f"/runs/{uuid}/stop")
             return
         self.store.request_stop(self.store.resolve(uuid))
+
+    # ------------------------------------------------- restart/resume/copy
+    def _op_from_run(self, src_uuid: str, suffix: str) -> V1Operation:
+        """Rebuild a submittable operation from a run's stored spec: the
+        resolved component plus the op-level params it was compiled with
+        (components with required inputs need them again), with caching
+        disabled — a clone exists to actually execute, and an identical
+        fingerprint would otherwise short-circuit to the source's results."""
+        spec = self.store.read_spec(src_uuid)
+        if not spec or "component" not in spec:
+            raise ClientError(f"run {src_uuid[:8]} has no stored spec")
+        params = {
+            k: (v if isinstance(v, dict) and "value" in v else {"value": v})
+            for k, v in (spec.get("params") or {}).items()
+        }
+        return V1Operation.model_validate(
+            {
+                "name": f"{spec.get('name') or 'run'}-{suffix}",
+                "component": spec["component"],
+                "params": params or None,
+                "cache": {"disable": True},
+            }
+        )
+
+    @staticmethod
+    def _run_inline(agent, uuid: str) -> None:
+        """Drain exactly THIS run from the queue and execute it; queued work
+        belonging to others is put back with its priority intact."""
+        entry = None
+        remaining = []
+        while True:
+            e = agent.queue.pop()
+            if e is None:
+                break
+            if e["uuid"] == uuid:
+                entry = e
+                break
+            remaining.append(e)
+        for e in remaining:
+            agent.queue.push(e["uuid"], e["payload"], e.get("priority", 0))
+        if entry is not None:
+            agent._process(entry)
+
+    def _clone(
+        self, uuid: str, suffix: str, *, op_patch=None, copy_outputs: bool, queue: bool
+    ) -> str:
+        import shutil
+
+        from ..scheduler.agent import Agent
+        from ..schemas.lifecycle import DONE_STATUSES
+
+        src = self.store.resolve(uuid)
+        if copy_outputs:
+            status = self.store.get_status(src).get("status")
+            if status not in DONE_STATUSES:
+                # copying a live run would snapshot half-written checkpoints
+                raise ClientError(
+                    f"cannot {suffix} run {src[:8]} while it is {status}; "
+                    "wait for a terminal status or stop it first"
+                )
+        op = self._op_from_run(src, suffix)
+        if op_patch is not None:
+            op = op_patch(op)
+
+        def prepare(compiled):
+            if copy_outputs:
+                src_out = self.store.outputs_dir(src)
+                if src_out.exists():
+                    shutil.copytree(
+                        src_out,
+                        self.store.outputs_dir(compiled.run_uuid),
+                        dirs_exist_ok=True,
+                    )
+            self.store.log_event(
+                src, "lineage", {"child": compiled.run_uuid, "clone_kind": suffix}
+            )
+
+        agent = Agent(store=self.store)
+        new_uuid = agent.submit(
+            op,
+            project=self.project,
+            meta={"cloned_from": src, "clone_kind": suffix},
+            prepare_fn=prepare,
+        )
+        if not queue:
+            self._run_inline(agent, new_uuid)
+        return new_uuid
+
+    def restart(self, uuid: str, *, queue: bool = True) -> str:
+        """Fresh run from the source's resolved spec (outputs start empty)."""
+        return self._clone(uuid, "restart", copy_outputs=False, queue=queue)
+
+    def copy(self, uuid: str, *, queue: bool = True) -> str:
+        """New run seeded with a COPY of the source outputs — a divergent
+        branch that can't clobber the original's artifacts."""
+        return self._clone(uuid, "copy", copy_outputs=True, queue=queue)
+
+    def resume(self, uuid: str, *, queue: bool = True) -> str:
+        """Continue training: outputs (incl. checkpoints) are inherited and
+        the program's train.resume flag is forced on, so the trainer restores
+        the latest checkpoint and picks up at that step."""
+
+        def patch(op: V1Operation) -> V1Operation:
+            data = op.to_dict()
+            run = data.get("component", {}).get("run", {})
+            program = run.get("program")
+            if program is not None:
+                program.setdefault("train", {})["resume"] = True
+            return V1Operation.model_validate(data)
+
+        return self._clone(
+            uuid, "resume", op_patch=patch, copy_outputs=True, queue=queue
+        )
 
     # ---------------------------------------------------------------- read
     def _resolve(self, uuid: str) -> str:

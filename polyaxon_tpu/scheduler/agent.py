@@ -39,8 +39,19 @@ class Agent:
         self.executor = Executor(store=self.store, devices=devices, catalog=catalog)
         self.submit_fn = submit_fn
 
-    def submit(self, op: V1Operation, *, project: str = "default", priority: int = 0) -> str:
-        """Compile + enqueue (the control-plane half of `polyaxon run`)."""
+    def submit(
+        self,
+        op: V1Operation,
+        *,
+        project: str = "default",
+        priority: int = 0,
+        meta: Optional[dict] = None,
+        prepare_fn: Optional[Callable] = None,
+    ) -> str:
+        """Compile + enqueue (the control-plane half of `polyaxon run`).
+        `prepare_fn(compiled)` runs after the run exists but BEFORE it is
+        queued — restart/resume use it to seed the new run's outputs without
+        racing a draining agent."""
         if op.joins:
             from .joins import resolve_joins
 
@@ -58,8 +69,10 @@ class Agent:
             tags=compiled.operation.tags,
             # recorded at creation: the executor's later create_run is a
             # no-op for existing runs, and the cache matches on this meta
-            meta={"fingerprint": spec_fingerprint(compiled)},
+            meta={"fingerprint": spec_fingerprint(compiled), **(meta or {})},
         )
+        if prepare_fn is not None:
+            prepare_fn(compiled)
         self.store.set_status(compiled.run_uuid, V1Statuses.COMPILED)
         self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
         self.queue.push(

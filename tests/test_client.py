@@ -181,3 +181,81 @@ def test_stop_while_running_cooperative(tmp_home, tmp_path):
     assert not t.is_alive(), "executor did not observe the stop"
     assert results["uuid"] == uuid
     assert client.get(uuid)["status"] == V1Statuses.STOPPED
+
+
+PROGRAM_OP = {
+    "version": 1.1,
+    "kind": "operation",
+    "name": "trainable",
+    "params": {"lr": {"value": 0.01}},
+    "component": {
+        "kind": "component",
+        "name": "trainable",
+        "cache": {"disable": False},
+        "inputs": [
+            {"name": "steps", "type": "int", "value": 6},
+            {"name": "lr", "type": "float"},
+        ],
+        "run": {
+            "kind": "jaxjob",
+            "program": {
+                "model": {
+                    "name": "mlp",
+                    "config": {"input_dim": 8, "num_classes": 2, "hidden": [4]},
+                },
+                "data": {
+                    "name": "synthetic",
+                    "batchSize": 8,
+                    "config": {"shape": [8], "num_classes": 2},
+                },
+                "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                "train": {
+                    "steps": "{{ params.steps }}",
+                    "logEvery": 2,
+                    "checkpointEvery": 2,
+                    "precision": "float32",
+                },
+            },
+        },
+    },
+}
+
+
+def test_restart_resume_copy(tmp_home, tmp_path):
+    client = RunClient()
+    src = client.create(_op(tmp_path, PROGRAM_OP), queue=False)
+    assert client.get(src)["status"] == V1Statuses.SUCCEEDED
+    assert client.metrics(src)[-1]["step"] == 6
+
+    # restart: fresh outputs, full re-run from step 1 — params from the
+    # stored spec are re-supplied (required input lr has no default) and the
+    # component's cache must NOT short-circuit the clone to stale results
+    r = client.restart(src, queue=False)
+    assert client.get(r)["status"] == V1Statuses.SUCCEEDED
+    assert client.metrics(r)[0]["step"] <= 2
+    assert client.get(r)["meta"]["clone_kind"] == "restart"
+    assert not any(e.get("kind") == "cache_hit" for e in client.events(r))
+
+    # copy: outputs seeded from the source before execution
+    c = client.copy(src, queue=False)
+    assert client.get(c)["status"] == V1Statuses.SUCCEEDED
+    assert any("checkpoints" in a for a in client.artifacts(c))
+
+    # resume: inherits checkpoints and continues from the saved step —
+    # first logged metric is past the source's final step? no: same total
+    # steps, so resume restores step 6 and has nothing left; metrics empty
+    # is legal. Assert lineage + restored step via events instead.
+    # resuming a non-terminal run is refused (torn-checkpoint protection)
+    live = client.create(_op(tmp_path, PROGRAM_OP), queue=True)  # still QUEUED
+    with pytest.raises(ClientError, match="wait for a terminal status"):
+        client.resume(live)
+
+    rs = client.resume(src, queue=False)
+    assert client.get(rs)["status"] == V1Statuses.SUCCEEDED
+    events = client.events(src)
+    kinds = [e.get("kind") for e in events]
+    assert kinds.count("lineage") == 3  # restart, copy, resume all recorded
+    clone_kinds = {e.get("clone_kind") for e in events if e.get("kind") == "lineage"}
+    assert clone_kinds == {"restart", "copy", "resume"}
+    summary = [e for e in client.events(rs) if e.get("kind") == "run_summary"]
+    assert summary  # resumed run completed and summarized
