@@ -355,6 +355,32 @@ def project_get(name):
 
 
 @cli.group()
+def queues():
+    """Named run queues (priority + concurrency per queue)."""
+
+
+@queues.command("ls")
+def queues_ls():
+    from ..scheduler.queue import QueueRegistry
+
+    for row in QueueRegistry(RunStore()).stats():
+        click.echo(json.dumps(row))
+
+
+@queues.command("set")
+@click.argument("name")
+@click.option("--concurrency", default=1, type=int)
+@click.option("--priority", default=0, type=int)
+def queues_set(name, concurrency, priority):
+    from ..scheduler.queue import QueueRegistry
+
+    QueueRegistry(RunStore()).set_queue(
+        name, concurrency=concurrency, priority=priority
+    )
+    click.echo(f"queue {name}: concurrency={concurrency} priority={priority}")
+
+
+@cli.group()
 def admin():
     """Platform administration."""
 

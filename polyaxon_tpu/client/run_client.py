@@ -96,7 +96,7 @@ class RunClient:
         agent = Agent(store=self.store)
         uuid = agent.submit(op, project=self.project)
         if not queue:
-            self._run_inline(agent, uuid)
+            self._run_inline(agent, op, uuid)
         return uuid
 
     def stop(self, uuid: str):
@@ -125,17 +125,22 @@ class RunClient:
                 "component": spec["component"],
                 "params": params or None,
                 "cache": {"disable": True},
+                # clones keep the source's queue routing and tags
+                "queue": spec.get("queue"),
+                "tags": spec.get("tags"),
             }
         )
 
     @staticmethod
-    def _run_inline(agent, uuid: str) -> None:
-        """Drain exactly THIS run from the queue and execute it; queued work
-        belonging to others is put back with its priority intact."""
+    def _run_inline(agent, op: V1Operation, uuid: str) -> None:
+        """Drain exactly THIS run from the queue it was routed to (the op's
+        `queue:` field decides) and execute it; queued work belonging to
+        others is put back with its priority intact."""
+        queue = agent.queue_for(op)
         entry = None
         remaining = []
         while True:
-            e = agent.queue.pop()
+            e = queue.pop()
             if e is None:
                 break
             if e["uuid"] == uuid:
@@ -143,7 +148,7 @@ class RunClient:
                 break
             remaining.append(e)
         for e in remaining:
-            agent.queue.push(e["uuid"], e["payload"], e.get("priority", 0))
+            queue.push(e["uuid"], e["payload"], e.get("priority", 0))
         if entry is not None:
             agent._process(entry)
 
@@ -189,7 +194,7 @@ class RunClient:
             prepare_fn=prepare,
         )
         if not queue:
-            self._run_inline(agent, new_uuid)
+            self._run_inline(agent, op, new_uuid)
         return new_uuid
 
     def restart(self, uuid: str, *, queue: bool = True) -> str:

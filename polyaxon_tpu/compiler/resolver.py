@@ -65,6 +65,10 @@ class CompiledOperation:
             "project": self.project,
             "params": self.params,
             "component": self.component.to_dict(),
+            # op-level routing/labels survive into the stored spec so
+            # restart/resume/copy clones inherit them
+            "queue": self.operation.queue,
+            "tags": self.operation.tags,
         }
 
 

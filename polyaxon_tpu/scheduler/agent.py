@@ -33,9 +33,17 @@ class Agent:
         submit_fn: Optional[Callable[[CompiledOperation], str]] = None,
         devices: Optional[list] = None,
         catalog=None,
+        queues: Optional[list[str]] = None,
     ):
+        from .queue import QueueRegistry
+
         self.store = store or RunStore()
+        self.registry = QueueRegistry(self.store)
+        # `queue` pins the agent to one explicit queue (tests/embedding);
+        # otherwise it drains every queue in the registry, `queues` filters
         self.queue = queue or RunQueue(self.store)
+        self._pinned = queue is not None
+        self.queue_filter = queues
         self.executor = Executor(store=self.store, devices=devices, catalog=catalog)
         self.submit_fn = submit_fn
 
@@ -75,7 +83,7 @@ class Agent:
             prepare_fn(compiled)
         self.store.set_status(compiled.run_uuid, V1Statuses.COMPILED)
         self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
-        self.queue.push(
+        self.queue_for(op).push(
             compiled.run_uuid,
             {"operation": compiled.operation.to_dict(), "project": compiled.project},
             priority=priority,
@@ -101,26 +109,71 @@ class Agent:
             return self.submit_fn(compiled)
         return self.executor.execute(compiled)
 
+    def queue_for(self, op: V1Operation) -> RunQueue:
+        """The queue an operation routes to: its `queue:` field (upstream:
+        ops target a named agent queue), unless this agent is pinned."""
+        if self._pinned or not op.queue:
+            return self.queue
+        return self.registry.get(op.queue)
+
+    def _queues(self) -> list[tuple[RunQueue, dict]]:
+        """(queue, settings) this agent drains, highest priority first —
+        config.json read ONCE per call, not per queue."""
+        if self._pinned:
+            return [(self.queue, {"concurrency": 1, "priority": 0})]
+        cfg = self.registry.config()
+        names = self.registry.names(cfg) or ["default"]
+        if self.queue_filter is not None:
+            names = [n for n in names if n in self.queue_filter]
+        return [(self.registry.get(n), self.registry.settings(n, cfg)) for n in names]
+
+    def _safe_process(self, entry: dict) -> None:
+        try:
+            self._process(entry)
+        except Exception as e:  # noqa: BLE001 — record on the run, keep draining
+            uid = entry.get("uuid")
+            try:
+                self.store.append_log(uid, f"agent: {type(e).__name__}: {e}")
+                self.store.set_status(
+                    uid, V1Statuses.FAILED, reason=type(e).__name__, message=str(e)
+                )
+            except Exception:
+                pass
+
     def drain(self, max_runs: Optional[int] = None) -> int:
-        """Process queued runs until empty (or max_runs); returns count.
-        A bad entry fails its own run and never kills the loop."""
+        """Process queued runs until every watched queue is empty (or
+        max_runs); returns count. Queues drain in configured-priority order;
+        a queue with concurrency > 1 runs that many entries at once (useful
+        for container jobs and cluster submits — device-bound jaxjobs share
+        one pool and belong on a concurrency-1 queue). A bad entry fails its
+        own run and never kills the loop."""
         count = 0
         while max_runs is None or count < max_runs:
-            entry = self.queue.pop()
-            if entry is None:
+            progressed = False
+            for q, settings in self._queues():
+                conc = int(settings.get("concurrency", 1))
+                budget = (max_runs - count) if max_runs is not None else None
+                take = conc if budget is None else min(conc, budget)
+                batch = []
+                for _ in range(max(1, take)):
+                    entry = q.pop()
+                    if entry is None:
+                        break
+                    batch.append(entry)
+                if not batch:
+                    continue
+                progressed = True
+                if len(batch) == 1:
+                    self._safe_process(batch[0])
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    with ThreadPoolExecutor(max_workers=len(batch)) as pool:
+                        list(pool.map(self._safe_process, batch))
+                count += len(batch)
+                break  # re-evaluate queue priority order after each batch
+            if not progressed:
                 break
-            try:
-                self._process(entry)
-            except Exception as e:  # noqa: BLE001 — record on the run, keep draining
-                uid = entry.get("uuid")
-                try:
-                    self.store.append_log(uid, f"agent: {type(e).__name__}: {e}")
-                    self.store.set_status(
-                        uid, V1Statuses.FAILED, reason=type(e).__name__, message=str(e)
-                    )
-                except Exception:
-                    pass
-            count += 1
         return count
 
     def serve(self, poll_interval: float = 1.0, stop_when=lambda: False):

@@ -73,3 +73,68 @@ class RunQueue:
 
     def __len__(self) -> int:
         return len(self.peek_all())
+
+
+class QueueRegistry:
+    """Named queues with per-queue settings (SURVEY.md §2 control plane:
+    upstream agents watch multiple queues with priority + concurrency).
+    Settings live in `<home>/queues/config.json`; a queue exists the moment
+    something is pushed to it, settings are optional."""
+
+    _DEFAULTS = {"concurrency": 1, "priority": 0}
+
+    def __init__(self, store: Optional[RunStore] = None):
+        self.store = store or RunStore()
+        self.dir = Path(self.store.home) / "queues"
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.config_path = self.dir / "config.json"
+        self._lock_path = self.dir / "config.lock"
+
+    def config(self) -> dict[str, dict]:
+        # atomic-replace writers mean a read never sees a torn file; a
+        # missing/corrupt file degrades to defaults instead of crashing
+        # the agent's drain loop
+        try:
+            return json.loads(self.config_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def set_queue(self, name: str, *, concurrency: int = 1, priority: int = 0):
+        """Locked read-modify-write + atomic replace: concurrent `queues
+        set` calls can't lose updates or expose half-written JSON."""
+        with open(self._lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                cfg = self.config()
+                cfg[name] = {
+                    "concurrency": int(concurrency),
+                    "priority": int(priority),
+                }
+                tmp = self.config_path.with_suffix(".json.tmp")
+                tmp.write_text(json.dumps(cfg, indent=1))
+                os.replace(tmp, self.config_path)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+
+    def settings(self, name: str, config: Optional[dict] = None) -> dict:
+        cfg = self.config() if config is None else config
+        return cfg.get(name, dict(self._DEFAULTS))
+
+    def names(self, config: Optional[dict] = None) -> list[str]:
+        """Configured queues ∪ queues with a backing file, highest queue
+        priority first (stable by name)."""
+        cfg = self.config() if config is None else config
+        found = {p.stem for p in self.dir.glob("*.jsonl")} | set(cfg)
+        return sorted(
+            found, key=lambda n: (-self.settings(n, cfg).get("priority", 0), n)
+        )
+
+    def get(self, name: str) -> RunQueue:
+        return RunQueue(self.store, name=name)
+
+    def stats(self) -> list[dict]:
+        cfg = self.config()
+        return [
+            {"name": n, "pending": len(self.get(n)), **self.settings(n, cfg)}
+            for n in self.names(cfg)
+        ]

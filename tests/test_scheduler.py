@@ -205,3 +205,105 @@ def test_agent_submit_and_drain(tmp_home, tmp_path):
     assert agent.drain() == 1
     assert store.get_status(uid)["status"] == V1Statuses.SUCCEEDED
     assert len(agent.queue) == 0
+
+
+# ------------------------------------------------------------ named queues
+def test_named_queues_routing_priority_and_concurrency(tmp_home, tmp_path):
+    """Operations route to their `queue:`; the agent drains queues in
+    configured priority order; concurrency>1 runs a batch in parallel."""
+    import time
+
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import Agent
+    from polyaxon_tpu.scheduler.queue import QueueRegistry
+    from polyaxon_tpu.schemas.lifecycle import V1Statuses
+    from polyaxon_tpu.store.local import RunStore
+
+    def op(name, queue, cmd):
+        spec = {
+            "version": 1.1,
+            "kind": "operation",
+            "name": name,
+            "queue": queue,
+            "component": {
+                "kind": "component",
+                "name": name,
+                "run": {"kind": "job", "container": {"command": ["sh", "-c", cmd]}},
+            },
+        }
+        p = tmp_path / f"{name}.yaml"
+        p.write_text(yaml.safe_dump(spec))
+        return read_polyaxonfile(str(p))
+
+    store = RunStore()
+    registry = QueueRegistry(store)
+    registry.set_queue("urgent", concurrency=1, priority=10)
+    registry.set_queue("bulk", concurrency=2, priority=0)
+
+    agent = Agent(store=store)
+    slow = agent.submit(op("slow-a", "bulk", "sleep 0.5; echo a"))
+    slow2 = agent.submit(op("slow-b", "bulk", "sleep 0.5; echo b"))
+    hot = agent.submit(op("hot", "urgent", "echo hot"))
+
+    stats = {s["name"]: s for s in registry.stats()}
+    assert stats["urgent"]["pending"] == 1 and stats["bulk"]["pending"] == 2
+    assert registry.names()[0] == "urgent"  # priority order
+
+    assert agent.drain() == 3
+    for uuid in (slow, slow2, hot):
+        assert store.get_status(uuid)["status"] == V1Statuses.SUCCEEDED
+
+    def cond_ts(uuid, kind):
+        return [
+            c for c in store.get_status(uuid)["conditions"] if c["type"] == kind
+        ][0]["ts"]
+
+    # the two 0.5s bulk jobs overlapped (concurrency=2): each started
+    # before the other finished — robust against slow CI, unlike wall-clock
+    assert cond_ts(slow, "running") < cond_ts(slow2, "succeeded")
+    assert cond_ts(slow2, "running") < cond_ts(slow, "succeeded")
+
+    # urgent (priority 10) was claimed before the bulk batch
+    hot_done = [
+        c for c in store.get_status(hot)["conditions"] if c["type"] == "succeeded"
+    ][0]["ts"]
+    bulk_done = [
+        c for c in store.get_status(slow)["conditions"] if c["type"] == "succeeded"
+    ][0]["ts"]
+    assert hot_done <= bulk_done
+
+
+def test_inline_create_respects_queue_routing(tmp_home, tmp_path):
+    """create(queue=False) must execute the run even when the op routes to
+    a named queue (regression: inline drain used to look only at default)."""
+    import yaml
+
+    from polyaxon_tpu.client import RunClient
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.schemas.lifecycle import V1Statuses
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "routed",
+        "queue": "special",
+        "component": {
+            "kind": "component",
+            "name": "routed",
+            "run": {"kind": "job", "container": {"command": ["sh", "-c", "echo r"]}},
+        },
+    }
+    p = tmp_path / "routed.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    client = RunClient()
+    uuid = client.create(read_polyaxonfile(str(p)), queue=False)
+    assert client.get(uuid)["status"] == V1Statuses.SUCCEEDED
+
+    # clones inherit the queue routing from the stored spec
+    r = client.restart(uuid, queue=True)
+    from polyaxon_tpu.scheduler.queue import RunQueue
+    from polyaxon_tpu.store.local import RunStore
+
+    assert any(e["uuid"] == r for e in RunQueue(RunStore(), name="special").peek_all())
