@@ -1,0 +1,47 @@
+"""Notifier data plane: deliver run lifecycle events to webhook connections
+(SURVEY.md §2 auxiliaries "notifier" — upstream posts to Slack/Discord/...
+sinks; here any webhook connection gets the event as JSON)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from .schemas import V1Connection
+
+
+class NotificationError(Exception):
+    pass
+
+
+def notify(conn: V1Connection, payload: dict, timeout: float = 5.0) -> None:
+    """POST `payload` as JSON to the webhook connection. A configured
+    `secret` is sent as a Bearer token AND an HMAC-SHA256 body signature
+    (X-Polyaxon-Signature), covering both auth styles receivers use.
+    Raises NotificationError on any failure — callers decide whether a
+    missed notification matters (run hooks log it and move on)."""
+    if conn.spec.kind != "webhook":
+        raise NotificationError(
+            f"connection {conn.name!r} is {conn.spec.kind!r}, not a webhook"
+        )
+    body = json.dumps(payload).encode()
+    headers = {"Content-Type": "application/json"}
+    if conn.spec.secret:
+        import hashlib
+        import hmac
+
+        headers["Authorization"] = f"Bearer {conn.spec.secret}"
+        headers["X-Polyaxon-Signature"] = (
+            "sha256="
+            + hmac.new(conn.spec.secret.encode(), body, hashlib.sha256).hexdigest()
+        )
+    req = urllib.request.Request(
+        conn.spec.url, data=body, headers=headers, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout):
+            pass  # any 2xx is success; urllib raises on 4xx/5xx
+    except urllib.error.HTTPError as e:
+        raise NotificationError(f"webhook {conn.spec.url}: HTTP {e.code}") from e
+    except Exception as e:  # noqa: BLE001 — network errors become one type
+        raise NotificationError(f"webhook {conn.spec.url}: {e}") from e

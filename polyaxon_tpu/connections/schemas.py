@@ -53,12 +53,22 @@ class V1RegistryConnection(BaseSchema):
     secret: Optional[str] = None
 
 
+class V1WebhookConnection(BaseSchema):
+    """Notification sink (Slack/Discord/generic webhooks): run lifecycle
+    hooks with `connection:` naming one of these POST the event as JSON."""
+
+    kind: Literal["webhook"] = "webhook"
+    url: str
+    secret: Optional[str] = None
+
+
 V1ConnectionSpec = Union[
     V1HostPathConnection,
     V1VolumeConnection,
     V1BucketConnection,
     V1GitConnection,
     V1RegistryConnection,
+    V1WebhookConnection,
 ]
 
 

@@ -174,7 +174,31 @@ class Executor:
                     )
                     self.execute(hook_compiled)
                 else:
-                    # hubRef/no-ref hooks degrade to a notification event
+                    # notifier hooks: deliver to the webhook connection when
+                    # one is named; always record the notification event
+                    delivered = None
+                    if hook.connection:
+                        from ..connections.notifier import (
+                            NotificationError,
+                            notify,
+                        )
+
+                        payload = {
+                            "run_uuid": run_uuid,
+                            "name": compiled.name,
+                            "project": compiled.project,
+                            "status": getattr(status, "value", str(status)),
+                            "hook": hook.hub_ref or "notifier",
+                        }
+                        try:
+                            notify(self.catalog.get(hook.connection), payload)
+                            delivered = True
+                        except (NotificationError, KeyError) as e:
+                            delivered = False
+                            store.append_log(
+                                run_uuid,
+                                f"notification to {hook.connection} failed: {e}",
+                            )
                     store.log_event(
                         run_uuid,
                         "notification",
@@ -182,6 +206,7 @@ class Executor:
                             "hook": hook.hub_ref or "notifier",
                             "status": getattr(status, "value", str(status)),
                             "connection": hook.connection,
+                            **({} if delivered is None else {"delivered": delivered}),
                         },
                     )
             except Exception as e:  # noqa: BLE001 — hooks never fail the run

@@ -152,10 +152,12 @@ class Agent:
             progressed = False
             for q, settings in self._queues():
                 conc = int(settings.get("concurrency", 1))
+                if conc <= 0:
+                    continue  # concurrency 0 = paused queue
                 budget = (max_runs - count) if max_runs is not None else None
-                take = conc if budget is None else min(conc, budget)
+                take = conc if budget is None else max(1, min(conc, budget))
                 batch = []
-                for _ in range(max(1, take)):
+                for _ in range(take):
                     entry = q.pop()
                     if entry is None:
                         break
@@ -186,5 +188,8 @@ class Agent:
                 registry.tick(self)
             except Exception as e:  # noqa: BLE001 — a bad schedule never kills the agent
                 print(f"schedule tick error: {e}")
-            if self.drain(max_runs=1) == 0:
+            # full drain per tick: an uncapped pass lets per-queue
+            # concurrency batches form (a max_runs=1 budget would clamp
+            # every batch to size 1 and silently disable the feature)
+            if self.drain() == 0:
                 time.sleep(poll_interval)

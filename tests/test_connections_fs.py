@@ -224,3 +224,86 @@ def test_sidecar_container_runs_alongside(tmp_home, tmp_path):
     logs = store.read_logs(compiled.run_uuid)
     assert "main-done" in logs
     assert "[sidecar] sidecar-alive" in logs
+
+
+# -------------------------------------------------------------- notifier
+def test_webhook_notifier_hook_delivers(tmp_home, tmp_path):
+    """A hook with a webhook connection POSTs the run's terminal status."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            import hashlib
+            import hmac
+
+            expect = "sha256=" + hmac.new(
+                b"s3cr3t", body, hashlib.sha256
+            ).hexdigest()
+            assert self.headers["Authorization"] == "Bearer s3cr3t"
+            assert self.headers["X-Polyaxon-Signature"] == expect
+            received.append(_json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        spec = {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "notify-me",
+            "hooks": [{"trigger": "succeeded", "connection": "slack"}],
+            "component": {
+                "kind": "component",
+                "name": "notify-me",
+                "run": {"kind": "job", "container": {"command": ["true"]}},
+            },
+        }
+        catalog = ConnectionCatalog.from_config(
+            [{"name": "slack", "spec": {"kind": "webhook",
+                                        "url": f"http://127.0.0.1:{port}/hook",
+                                        "secret": "s3cr3t"}}]
+        )
+        store = RunStore()
+        compiled = _compile(tmp_path, spec)
+        assert Executor(store, catalog=catalog).execute(compiled) == V1Statuses.SUCCEEDED
+        assert received and received[0]["status"] == "succeeded"
+        assert received[0]["run_uuid"] == compiled.run_uuid
+        events = [e for e in store.read_events(compiled.run_uuid)
+                  if e.get("kind") == "notification"]
+        assert events and events[0]["delivered"] is True
+    finally:
+        server.shutdown()
+
+
+def test_webhook_notifier_failure_never_fails_run(tmp_home, tmp_path):
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "notify-dead",
+        "hooks": [{"connection": "dead"}],
+        "component": {
+            "kind": "component",
+            "name": "notify-dead",
+            "run": {"kind": "job", "container": {"command": ["true"]}},
+        },
+    }
+    catalog = ConnectionCatalog.from_config(
+        [{"name": "dead", "spec": {"kind": "webhook",
+                                    "url": "http://127.0.0.1:1/nope"}}]
+    )
+    store = RunStore()
+    compiled = _compile(tmp_path, spec)
+    assert Executor(store, catalog=catalog).execute(compiled) == V1Statuses.SUCCEEDED
+    events = [e for e in store.read_events(compiled.run_uuid)
+              if e.get("kind") == "notification"]
+    assert events and events[0]["delivered"] is False

@@ -307,3 +307,35 @@ def test_inline_create_respects_queue_routing(tmp_home, tmp_path):
     from polyaxon_tpu.store.local import RunStore
 
     assert any(e["uuid"] == r for e in RunQueue(RunStore(), name="special").peek_all())
+
+
+def test_concurrency_zero_pauses_queue(tmp_home, tmp_path):
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import Agent
+    from polyaxon_tpu.scheduler.queue import QueueRegistry
+    from polyaxon_tpu.store.local import RunStore
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "paused",
+        "queue": "paused-q",
+        "component": {
+            "kind": "component",
+            "name": "paused",
+            "run": {"kind": "job", "container": {"command": ["true"]}},
+        },
+    }
+    p = tmp_path / "p.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    store = RunStore()
+    QueueRegistry(store).set_queue("paused-q", concurrency=0)
+    agent = Agent(store=store)
+    uid = agent.submit(read_polyaxonfile(str(p)))
+    assert agent.drain() == 0  # paused: nothing claimed
+    assert store.get_status(uid)["status"] == V1Statuses.QUEUED
+    QueueRegistry(store).set_queue("paused-q", concurrency=1)
+    assert agent.drain() == 1
+    assert store.get_status(uid)["status"] == V1Statuses.SUCCEEDED
