@@ -244,7 +244,25 @@ class V1Bayes(V1MatrixBase):
     max_iterations: int
     metric: V1OptimizationMetric
     utility_function: Optional[dict] = None  # {acquisitionFunction: ucb|ei|pi, kappa, eps}
+    # gp: global GP + acquisition; turbo: trust-region BO (Eriksson et al.
+    # 2019); baxus: expanding-subspace BO (Papenmeier et al. 2022)
+    algorithm: Literal["gp", "turbo", "baxus"] = "gp"
+    trust_region: Optional[dict] = None  # {lengthInit,lengthMin,lengthMax,succTol,failTol}
+    initial_target_dim: Optional[int] = None  # baxus: starting subspace dim
     seed: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _check_algorithm_options(self):
+        if self.algorithm != "gp" and self.utility_function:
+            # turbo/baxus select via Thompson sampling inside the trust
+            # region — a ucb/ei/pi utility would be silently ignored
+            raise ValueError(
+                f"utilityFunction only applies to algorithm 'gp'; "
+                f"{self.algorithm!r} uses Thompson sampling (tune trustRegion instead)"
+            )
+        if self.algorithm == "gp" and self.trust_region:
+            raise ValueError("trustRegion requires algorithm 'turbo' or 'baxus'")
+        return self
 
 
 class V1Hyperopt(V1MatrixBase):

@@ -240,34 +240,23 @@ class BayesSearchManager(SearchManager):
         return [Suggestion(params=self._decode(u))]
 
     def observe(self, results):
+        prev_best = max(self._y) if self._y else None
+        had_result = False
         for sug, obj in results:
             if obj is None:
                 continue
+            had_result = True
             self._X.append(self._encode(sug.params))
             self._y.append(float(obj))
         self._iteration += 1
+        self._after_observe(prev_best, had_result)
+
+    def _after_observe(self, prev_best, had_result):
+        """Hook for trust-region subclasses; base GP search has no state."""
 
     # GP machinery ----------------------------------------------------
     def _gp_posterior(self, Xs: np.ndarray):
-        X = np.asarray(self._X)
-        y = np.asarray(self._y)
-        mu0 = y.mean() if len(y) else 0.0
-        sig0 = y.std() + 1e-9 if len(y) else 1.0
-        yn = (y - mu0) / sig0
-        ls, noise = 0.2, 1e-6
-
-        def k(a, b):
-            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-            return np.exp(-0.5 * d2 / ls**2)
-
-        K = k(X, X) + noise * np.eye(len(X))
-        L = np.linalg.cholesky(K)
-        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
-        Ks = k(X, Xs)  # [n, m]
-        mu = Ks.T @ alpha
-        v = np.linalg.solve(L, Ks)
-        var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
-        return mu * sig0 + mu0, np.sqrt(var) * sig0
+        return gp_posterior(np.asarray(self._X), np.asarray(self._y), Xs, ls=0.2)
 
     def _maximize_acquisition(self) -> np.ndarray:
         m = 512
@@ -286,6 +275,28 @@ class BayesSearchManager(SearchManager):
         else:
             raise ValueError(f"unknown acquisition {self._acq!r}")
         return cand[int(np.argmax(score))]
+
+
+def gp_posterior(X: np.ndarray, y: np.ndarray, Xs: np.ndarray, ls: float):
+    """Shared RBF-kernel GP posterior (unit-variance prior, Cholesky solve):
+    → (mu, sd) at candidate points Xs. One copy for every BO manager."""
+    mu0 = y.mean() if len(y) else 0.0
+    sig0 = y.std() + 1e-9 if len(y) else 1.0
+    yn = (y - mu0) / sig0
+    noise = 1e-6
+
+    def k(a, b):
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / ls**2)
+
+    K = k(X, X) + noise * np.eye(len(X))
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+    Ks = k(X, Xs)  # [n, m]
+    mu = Ks.T @ alpha
+    v = np.linalg.solve(L, Ks)
+    var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+    return mu * sig0 + mu0, np.sqrt(var) * sig0
 
 
 def _ncdf(z):
@@ -390,13 +401,239 @@ class IterativeManager(SearchManager):
         self._iteration += 1
 
 
+class _TrustRegion:
+    """TuRBO-style trust-region state (Eriksson et al. 2019): a box around
+    the incumbent whose side length doubles after `succ_tol` consecutive
+    improvements and halves after `fail_tol` consecutive misses; collapse
+    below `length_min` signals a restart (or, in BAxUS, a subspace split)."""
+
+    def __init__(self, dim: int, cfg: Optional[dict] = None):
+        cfg = {**(cfg or {})}
+        get = lambda *keys, default: next(  # noqa: E731
+            (float(cfg[k]) for k in keys if k in cfg), default
+        )
+        self.length_init = get("lengthInit", "length_init", default=0.8)
+        self.length_min = get("lengthMin", "length_min", default=0.5**7)
+        self.length_max = get("lengthMax", "length_max", default=1.6)
+        self.succ_tol = int(get("succTol", "succ_tol", default=3))
+        self.fail_tol = int(get("failTol", "fail_tol", default=max(4.0, float(dim))))
+        self.length = self.length_init
+        self._succ = self._fail = 0
+
+    def update(self, improved: bool):
+        if improved:
+            self._succ, self._fail = self._succ + 1, 0
+            if self._succ >= self.succ_tol:
+                self.length = min(2.0 * self.length, self.length_max)
+                self._succ = 0
+        else:
+            self._succ, self._fail = 0, self._fail + 1
+            if self._fail >= self.fail_tol:
+                self.length /= 2.0
+                self._fail = 0
+
+    @property
+    def collapsed(self) -> bool:
+        return self.length < self.length_min
+
+    def reset(self):
+        self.length = self.length_init
+        self._succ = self._fail = 0
+
+
+class _TrustRegionSearch:
+    """Shared trust-region bookkeeping for TuRBO/BAxUS: rounds with no
+    completed trial (all objectives None — infrastructure failures) do NOT
+    count as evaluated misses, so crashes alone never shrink the region."""
+
+    _tr: _TrustRegion
+    _y: list[float]
+
+    def _update_trust_region(self, prev_best, had_result):
+        if not had_result or prev_best is None:
+            return
+        best = max(self._y)
+        improved = best > prev_best + 1e-3 * abs(prev_best)
+        self._tr.update(improved)
+        if self._tr.collapsed:
+            self._on_collapse()
+
+    def _on_collapse(self):
+        self._tr.reset()
+
+
+class TurboBayesManager(_TrustRegionSearch, BayesSearchManager):
+    """Trust-region BO (TuRBO-1): the GP's Thompson sample is maximized only
+    inside a box around the incumbent, so the search exploits locally
+    instead of over-exploring the corners the way a global acquisition does
+    in higher dimensions. On collapse the region restarts at full size
+    around the running incumbent (observations are kept — the local GP has
+    more data than a cold restart and the box keeps it local)."""
+
+    def __init__(self, matrix: V1Bayes):
+        super().__init__(matrix)
+        self._tr = _TrustRegion(len(self._names), matrix.trust_region)
+
+    def _after_observe(self, prev_best, had_result):
+        self._update_trust_region(prev_best, had_result)
+
+    def _maximize_acquisition(self) -> np.ndarray:
+        if not self._X:
+            return self._rng.random(len(self._names))
+        center = np.asarray(self._X[int(np.argmax(self._y))])
+        half = self._tr.length / 2.0
+        lb = np.clip(center - half, 0.0, 1.0)
+        ub = np.clip(center + half, 0.0, 1.0)
+        cand = lb + (ub - lb) * self._rng.random((512, len(self._names)))
+        mu, sd = self._gp_posterior(cand)
+        # Thompson sample: one posterior draw per candidate (TuRBO's choice —
+        # naturally balances explore/exploit inside the region)
+        draw = mu + sd * self._rng.standard_normal(len(cand))
+        return cand[int(np.argmax(draw))]
+
+
+class BaxusBayesManager(_TrustRegionSearch, SearchManager):
+    """Expanding-subspace BO (BAxUS, Papenmeier et al. 2022 — the fork
+    author's research line; SURVEY.md:36-38 flags Polytune as the likely
+    fork divergence): BO runs in a low-dimensional target space embedded
+    into the full parameter space by a sparse axis-aligned ±1 assignment
+    (every input dim belongs to exactly one target bin). When the trust
+    region collapses, each bin SPLITS, doubling the target dimension while
+    re-expressing every past observation EXACTLY in the finer space — no
+    information is discarded on the way from d0 up to the full D."""
+
+    def __init__(self, matrix: V1Bayes):
+        self.matrix = matrix
+        self._rng = np.random.default_rng(matrix.seed or 0)
+        self._names = sorted(matrix.params)
+        D = len(self._names)
+        d0 = int(matrix.initial_target_dim or min(2, D))
+        self._d = max(1, min(d0, D))
+        # input dim i → (bin, sign): bins as equal contiguous groups
+        bins = np.array_split(np.arange(D), self._d)
+        self._bin = np.empty(D, dtype=int)
+        for b, idxs in enumerate(bins):
+            self._bin[idxs] = b
+        self._sign = self._rng.choice([-1.0, 1.0], size=D)
+        self._Z: list[np.ndarray] = []  # target-space points in [-1, 1]^d
+        self._y: list[float] = []
+        self._iteration = 0
+        self._tr = _TrustRegion(self._d, matrix.trust_region)
+
+    @property
+    def done(self) -> bool:
+        return self._iteration >= self.matrix.max_iterations + 1
+
+    @property
+    def target_dim(self) -> int:
+        return self._d
+
+    # ---------------------------------------------------------- embedding
+    def _embed(self, z: np.ndarray) -> np.ndarray:
+        """[-1,1]^d target point → unit-cube input point."""
+        x = 0.5 + 0.5 * self._sign * z[self._bin]
+        return np.clip(x, 0.0, 1.0)
+
+    def _decode(self, z: np.ndarray) -> dict:
+        x = self._embed(z)
+        return {
+            n: from_unit(self.matrix.params[n], float(x[i]))
+            for i, n in enumerate(self._names)
+        }
+
+    def _split_bins(self):
+        """Double the target dimension: each bin's input dims are split
+        into two child bins; a past z re-expressed with both children equal
+        to the parent coordinate embeds to the IDENTICAL input point."""
+        D = len(self._names)
+        new_bin = np.empty(D, dtype=int)
+        child_of: list[int] = []  # new bin index → parent bin
+        next_id = 0
+        for b in range(self._d):
+            idxs = np.where(self._bin == b)[0]
+            halves = [h for h in np.array_split(idxs, 2) if len(h)]
+            for h in halves:
+                new_bin[h] = next_id
+                child_of.append(b)
+                next_id += 1
+        self._Z = [z[np.asarray(child_of)] for z in self._Z]
+        self._bin = new_bin
+        self._d = next_id
+        self._tr = _TrustRegion(self._d, self.matrix.trust_region)
+
+    # ------------------------------------------------------------- search
+    def suggest(self) -> list[Suggestion]:
+        if self._iteration == 0:
+            return [
+                Suggestion(
+                    params=self._decode(self._rng.uniform(-1, 1, self._d))
+                )
+                for _ in range(self.matrix.num_initial_runs)
+            ]
+        z = self._next_point()
+        return [Suggestion(params=self._decode(z))]
+
+    def _next_point(self) -> np.ndarray:
+        if not self._Z:
+            return self._rng.uniform(-1, 1, self._d)
+        Z = np.stack(self._Z)
+        center = Z[int(np.argmax(self._y))]
+        half = self._tr.length  # z-space spans [-1,1]: length is the half-width
+        lb = np.clip(center - half, -1.0, 1.0)
+        ub = np.clip(center + half, -1.0, 1.0)
+        cand = lb + (ub - lb) * self._rng.random((512, self._d))
+        # z-space spans [-1,1]: wider lengthscale than the unit-cube GP
+        mu, sd = gp_posterior(Z, np.asarray(self._y), cand, ls=0.4)
+        draw = mu + sd * self._rng.standard_normal(len(cand))
+        return cand[int(np.argmax(draw))]
+
+    def observe(self, results):
+        prev_best = max(self._y) if self._y else None
+        had_result = False
+        for sug, obj in results:
+            if obj is None:
+                continue
+            had_result = True
+            self._Z.append(self._z_for(sug))
+            self._y.append(float(obj))
+        self._iteration += 1
+        self._update_trust_region(prev_best, had_result)
+
+    def _on_collapse(self):
+        if self._d < len(self._names):
+            self._split_bins()
+        else:
+            self._tr.reset()
+
+    def _z_for(self, sug: Suggestion) -> np.ndarray:
+        """Recover the target point for a suggestion: invert the embedding
+        bin-by-bin (each bin's coordinate is over-determined by its input
+        dims; use the mean of the consistent estimates)."""
+        x = np.array(
+            [to_unit(self.matrix.params[n], sug.params[n]) for n in self._names]
+        )
+        zhat = self._sign * (2.0 * x - 1.0)
+        z = np.zeros(self._d)
+        for b in range(self._d):
+            z[b] = zhat[self._bin == b].mean()
+        return np.clip(z, -1.0, 1.0)
+
+
+def _build_bayes(matrix: V1Bayes) -> SearchManager:
+    return {
+        "gp": BayesSearchManager,
+        "turbo": TurboBayesManager,
+        "baxus": BaxusBayesManager,
+    }[matrix.algorithm](matrix)
+
+
 def build_manager(matrix: V1Matrix) -> SearchManager:
     managers = {
         "grid": GridSearchManager,
         "random": RandomSearchManager,
         "mapping": MappingManager,
         "hyperband": HyperbandManager,
-        "bayes": BayesSearchManager,
+        "bayes": _build_bayes,
         "hyperopt": HyperoptManager,
         "iterative": IterativeManager,
     }
