@@ -176,6 +176,34 @@ def ops_metrics(uid):
         click.echo(json.dumps(m))
 
 
+@ops.command("artifacts")
+@click.option("-uid", "--uid", required=True)
+@click.option("--path", default=None, help="artifact path to download (omit to list)")
+@click.option("-o", "--output", default=".", help="download destination dir")
+def ops_artifacts(uid, path, output):
+    """List a run's output artifacts, or download one with --path."""
+    import shutil
+    from pathlib import Path as _Path
+
+    store = RunStore()
+    uid = store.resolve(uid)
+    root = store.outputs_dir(uid)
+    if path is None:
+        files = [str(p.relative_to(root)) for p in sorted(root.rglob("*")) if p.is_file()]
+        if not files:
+            click.echo("no artifacts")
+        for f in files:
+            click.echo(f)
+        return
+    src = (root / path).resolve()
+    if not (src == root.resolve() or root.resolve() in src.parents) or not src.is_file():
+        raise click.ClickException(f"no artifact {path!r} in run {uid[:8]}")
+    dst = _Path(output) / _Path(path).name
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy2(src, dst)
+    click.echo(str(dst))
+
+
 @ops.command("stop")
 @click.option("-uid", "--uid", required=True)
 def ops_stop(uid):

@@ -1,9 +1,10 @@
 """Data pipelines: `program.data.name` → an infinite iterator of batches.
 
-The environment has zero egress, so real dataset downloads are impossible;
-every pipeline here is procedurally generated but *learnable* (fixed class
-prototypes + noise) so training curves actually descend — that is what the
-reference's examples demonstrate and what tests assert.
+Two families: procedural streams (synthetic.py — the zero-egress image has
+no dataset downloads, so generated-but-*learnable* data stands in: fixed
+class prototypes + noise, so training curves actually descend) and
+file-backed pipelines (files.py — memory-mapped token corpora and .npy
+array datasets for real data on disk).
 
 Pipelines yield host-local numpy batches with STATIC shapes; the trainer
 lays them onto the mesh (runtime/trainer.py). Generation happens on CPU in
@@ -13,3 +14,4 @@ index so global batches are disjoint under data parallelism.
 
 from .registry import DataSpec, build_data, register_dataset, registered_datasets  # noqa: F401
 from . import synthetic  # noqa: F401  (registers pipelines)
+from . import files  # noqa: F401  (registers token_file/array_file)

@@ -42,12 +42,19 @@ def build_data(
         raise ValueError(
             f"global batch {batch_size} not divisible by {process_count} hosts"
         )
-    return _DATASETS[name](
+    import inspect
+
+    kwargs = dict(
         batch_size=batch_size // process_count,
         config=dict(config or {}),
         seed=seed,
         process_index=process_index,
     )
+    # newer pipelines take process_count for true interleaved host sharding;
+    # older procedural streams decorrelate by seed alone
+    if "process_count" in inspect.signature(_DATASETS[name]).parameters:
+        kwargs["process_count"] = process_count
+    return _DATASETS[name](**kwargs)
 
 
 def registered_datasets() -> list[str]:

@@ -235,24 +235,92 @@ class Trainer:
 
         param_dtype = self.param_dtype
 
-        def step_fn(state: TrainState, batch):
-            rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+        grad_accum = int(tspec.grad_accum) if tspec.grad_accum else 1
+        if grad_accum < 1:
+            raise ValueError(f"train.gradAccum must be >= 1, got {grad_accum}")
+        if global_batch % (grad_accum * local_batch_slice(mesh)) != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by gradAccum "
+                f"{grad_accum} x batch-sharded mesh axes {local_batch_slice(mesh)}"
+            )
 
-            def loss_of(params):
+        def grads_of(params, extra, batch, rng):
+            """One microbatch: (loss, grads, new_extra, logits)."""
+
+            def loss_of(p):
                 compute_params = (
-                    _cast_floats(params, compute_dtype)
+                    _cast_floats(p, compute_dtype)
                     if compute_dtype != param_dtype
-                    else params
+                    else p
                 )
                 inputs = batch["inputs"]
                 if jnp.issubdtype(inputs.dtype, jnp.floating):
                     inputs = inputs.astype(compute_dtype)
-                logits, new_extra, aux = apply(compute_params, state.extra, inputs, rng)
+                logits, new_extra, aux = apply(compute_params, extra, inputs, rng)
                 return loss_fn(logits, batch) + aux, (logits, new_extra)
 
             (loss, (logits, new_extra)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
-            )(state.params)
+            )(params)
+            return loss, grads, new_extra, logits
+
+        def step_fn(state: TrainState, batch):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+
+            if grad_accum == 1:
+                loss, grads, new_extra, logits = grads_of(
+                    state.params, state.extra, batch, rng
+                )
+                acc_metric = (
+                    accuracy_metric(logits, batch) if is_classification else None
+                )
+            else:
+                # microbatch scan: grads accumulate in param dtype; ONE
+                # optimizer update per step. The leading batch dim splits
+                # [B] → [A, B/A]; XLA keeps the data-axis sharding on the
+                # inner dim, so each microbatch is still mesh-parallel.
+                micro = jax.tree.map(
+                    lambda x: x.reshape(
+                        grad_accum, x.shape[0] // grad_accum, *x.shape[1:]
+                    ),
+                    batch,
+                )
+
+                def one(carry, mb):
+                    extra_c, grads_c, loss_c, acc_c, i = carry
+                    loss, grads, new_extra, logits = grads_of(
+                        state.params, extra_c, mb, jax.random.fold_in(rng, i)
+                    )
+                    grads = _cast_floats(grads, param_dtype)
+                    grads_c = jax.tree.map(jnp.add, grads_c, grads)
+                    acc = (
+                        accuracy_metric(logits, mb)
+                        if is_classification
+                        else jnp.zeros((), jnp.float32)
+                    )
+                    return (new_extra, grads_c, loss_c + loss, acc_c + acc, i + 1), None
+
+                zero_grads = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, param_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.zeros_like(x),
+                    state.params,
+                )
+                carry, _ = jax.lax.scan(
+                    one,
+                    (
+                        state.extra,
+                        zero_grads,
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.int32),
+                    ),
+                    micro,
+                )
+                new_extra, grads, loss, acc_sum, _ = carry
+                grads = jax.tree.map(lambda g: g / grad_accum, grads)
+                loss = loss / grad_accum
+                acc_metric = acc_sum / grad_accum if is_classification else None
             # grads come out in compute dtype; update math runs in param dtype
             grads = _cast_floats(grads, param_dtype)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -262,8 +330,8 @@ class Trainer:
                 "learning_rate": jnp.asarray(sched(state.step), jnp.float32),
                 "grad_norm": optax.global_norm(grads).astype(jnp.float32),
             }
-            if is_classification:
-                metrics["accuracy"] = accuracy_metric(logits, batch)
+            if acc_metric is not None:
+                metrics["accuracy"] = acc_metric
             return (
                 TrainState(
                     step=state.step + 1,

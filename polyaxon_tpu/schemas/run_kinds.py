@@ -90,6 +90,11 @@ class V1TrainSpec(BaseSchema):
     remat: Optional[bool] = None
     donate_state: bool = True
     loss: Optional[str] = None
+    # microbatch gradient accumulation: the per-step batch is split into
+    # this many sequential microbatches (lax.scan) before ONE optimizer
+    # update — trades step latency for a bigger effective batch in the
+    # same HBM footprint
+    grad_accum: Optional[int | str] = None
 
 
 class V1Program(BaseSchema):

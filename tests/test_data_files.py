@@ -1,0 +1,94 @@
+"""File-backed data pipelines: memory-mapped token corpora and .npy array
+datasets, end-to-end through the trainer."""
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.data import build_data
+
+
+def test_token_file_bin_and_npy(tmp_path):
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, 512, size=4096).astype(np.uint16)
+    bin_path = tmp_path / "corpus.bin"
+    corpus.tofile(bin_path)
+    npy_path = tmp_path / "corpus.npy"
+    np.save(npy_path, corpus.astype(np.int32))
+
+    for path, dtype in ((bin_path, "uint16"), (npy_path, None)):
+        spec = build_data(
+            "token_file",
+            8,
+            {"path": str(path), "seq_len": 64, "dtype": dtype},
+            seed=1,
+        )
+        batch = next(spec.iterator)
+        assert batch["inputs"].shape == (8, 64)
+        assert batch["labels"].shape == (8, 64)
+        # next-token alignment: labels are inputs shifted by one
+        b2 = next(spec.iterator)
+        assert (b2["inputs"][:, 1:] == b2["labels"][:, :-1]).all()
+        assert spec.meta["corpus_tokens"] == 4096
+
+
+def test_token_file_host_sharding_disjoint_streams(tmp_path):
+    # token value == its offset, so a window's first token IS its start
+    corpus = np.arange(8192, dtype=np.uint16)
+    path = tmp_path / "c.bin"
+    corpus.tofile(path)
+    a = build_data("token_file", 4, {"path": str(path), "seq_len": 32},
+                   seed=5, process_index=0, process_count=2)
+    b = build_data("token_file", 4, {"path": str(path), "seq_len": 32},
+                   seed=5, process_index=1, process_count=2)
+    # disjoint by construction: host 0 draws even starts, host 1 odd —
+    # no window can ever appear on both hosts in any step
+    seen_a, seen_b = set(), set()
+    for _ in range(8):
+        seen_a.update(int(x) for x in next(a.iterator)["inputs"][:, 0])
+        seen_b.update(int(x) for x in next(b.iterator)["inputs"][:, 0])
+    assert not (seen_a & seen_b), "hosts sampled overlapping windows"
+
+
+def test_token_file_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        build_data("token_file", 4, {"path": str(tmp_path / "nope.bin")})
+    tiny = tmp_path / "tiny.bin"
+    np.arange(4, dtype=np.uint16).tofile(tiny)
+    with pytest.raises(ValueError, match="need at least"):
+        next(build_data("token_file", 4, {"path": str(tiny), "seq_len": 64}).iterator)
+
+
+def test_array_file_classification_end_to_end(tmp_home, tmp_path):
+    """array_file feeds the trainer: a linearly-separable .npy dataset
+    trains an MLP to near-zero loss through the full runtime."""
+    rng = np.random.default_rng(3)
+    protos = rng.normal(size=(4, 16)).astype(np.float32)
+    labels = rng.integers(0, 4, size=512)
+    inputs = protos[labels] + 0.1 * rng.normal(size=(512, 16)).astype(np.float32)
+    np.save(tmp_path / "x.npy", inputs.astype(np.float32))
+    np.save(tmp_path / "y.npy", labels.astype(np.int64))
+
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="mlp", config={"input_dim": 16, "num_classes": 4, "hidden": [32]}
+        ),
+        data=V1DataSpec(
+            name="array_file",
+            batch_size=32,
+            config={"inputs": str(tmp_path / "x.npy"), "labels": str(tmp_path / "y.npy")},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=0.01),
+        train=V1TrainSpec(steps=40, log_every=40, precision="float32"),
+    )
+    result = Trainer(program, mesh_axes={"data": -1}).run()
+    assert result.history[-1]["loss"] < 0.3
+    assert result.history[-1]["accuracy"] > 0.9

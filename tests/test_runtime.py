@@ -192,3 +192,74 @@ class TestCli:
         spec = json.loads(res.output)
         # mesh -1 resolved against the 2x4 tpu slice
         assert spec["component"]["run"]["mesh"] == {"data": 8}
+
+
+def test_grad_accum_matches_full_batch(tmp_home):
+    """gradAccum=4 over a batch of 32 must take the same first optimizer
+    step as one full-batch update (same data, float32, SGD) — accumulation
+    is exact, not approximate."""
+    import jax
+    import numpy as np
+
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    def prog(accum):
+        return V1Program(
+            model=V1ModelSpec(
+                name="mlp", config={"input_dim": 8, "num_classes": 2, "hidden": [4]}
+            ),
+            data=V1DataSpec(
+                name="synthetic", batch_size=32,
+                config={"shape": [8], "num_classes": 2},
+            ),
+            optimizer=V1OptimizerSpec(name="sgd", learning_rate=0.1),
+            train=V1TrainSpec(
+                steps=1, log_every=1, precision="float32", seed=3,
+                grad_accum=accum, donate_state=False,
+            ),
+        )
+
+    dev = [jax.devices()[0]]
+    t_full = Trainer(prog(None), devices=dev)
+    t_acc = Trainer(prog(4), devices=dev)
+    r_full = t_full.run()
+    r_acc = t_acc.run()
+    # same seed → same data stream → identical first-step loss and params
+    assert abs(r_full.history[0]["loss"] - r_acc.history[0]["loss"]) < 1e-5
+    for a, b in zip(
+        jax.tree.leaves(t_full.state.params), jax.tree.leaves(t_acc.state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_grad_accum_trains_on_mesh(tmp_home):
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="mlp", config={"input_dim": 16, "num_classes": 4, "hidden": [8]}
+        ),
+        data=V1DataSpec(
+            name="synthetic", batch_size=32, config={"shape": [16], "num_classes": 4}
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=0.01),
+        train=V1TrainSpec(steps=20, log_every=20, precision="float32", grad_accum=2),
+    )
+    result = Trainer(program, mesh_axes={"data": -1}).run()
+    first, last = result.history[0], result.history[-1]
+    assert last["loss"] == last["loss"]  # finite
+    assert last["loss"] < 1.6  # descending on the learnable stream
