@@ -9,5 +9,6 @@ from .registry import (  # noqa: F401
 from . import bert  # noqa: F401
 from . import mlp  # noqa: F401
 from . import resnet  # noqa: F401
+from . import seq2seq  # noqa: F401
 from . import transformer  # noqa: F401
 from . import vit  # noqa: F401

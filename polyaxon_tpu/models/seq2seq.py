@@ -1,0 +1,189 @@
+"""Encoder-decoder transformer (T5-shaped) — the seq2seq family.
+
+The reference orchestrates arbitrary user models (SURVEY.md §1: training
+compute lives in user containers); since this framework owns the training
+runtime, the model zoo carries every mainstream transformer shape: decoder
+LM (transformer.py), encoder MLM (bert.py), ViT (vit.py), and this
+encoder-decoder.
+
+Batch schema trick: one packed token stream per example —
+`[src_0..src_{S-1}, tgt_in_0..tgt_in_{T-1}]` with labels `-100` on the
+source span — so the generic trainer and the `masked_lm` loss work
+unchanged (loss only lands on decoder positions). The split point is
+static config (`src_len`), keeping shapes XLA-friendly.
+
+Decoder blocks: pre-LN causal self-attention → cross-attention over the
+encoder output → GELU MLP. Cross-attention reuses the shared backend
+dispatch (xla handles S_q != S_kv natively). Projection names match the
+rest of the zoo so ENCODER_RULES covers TP/FSDP sharding."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .encoder import ENCODER_RULES, EncoderBlock
+from .registry import ModelBundle, i32_tokens, register
+
+PRESETS = {
+    "tiny-test": dict(
+        dim=128, n_layers=2, n_heads=4, src_len=32, tgt_len=32, vocab_size=1024
+    ),
+    "small": dict(
+        dim=512, n_layers=6, n_heads=8, src_len=512, tgt_len=512, vocab_size=32128
+    ),
+    "base": dict(
+        dim=768, n_layers=12, n_heads=12, src_len=512, tgt_len=512, vocab_size=32128
+    ),
+}
+
+
+class CrossAttention(nn.Module):
+    dim: int
+    n_heads: int
+    backend: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, memory):
+        from ..ops.attention import dot_product_attention
+
+        B, T, _ = x.shape
+        S = memory.shape[1]
+        hd = self.dim // self.n_heads
+        q = nn.Dense(self.dim, name="q_proj")(x).reshape(B, T, self.n_heads, hd)
+        k = nn.Dense(self.dim, name="k_proj")(memory).reshape(B, S, self.n_heads, hd)
+        v = nn.Dense(self.dim, name="v_proj")(memory).reshape(B, S, self.n_heads, hd)
+        out = dot_product_attention(q, k, v, causal=False, backend="xla")
+        return nn.Dense(self.dim, name="o_proj")(out.reshape(B, T, self.dim))
+
+
+class DecoderBlock(nn.Module):
+    dim: int
+    n_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    backend: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, memory, *, train: bool = False):
+        from ..ops.attention import dot_product_attention
+
+        drop = (
+            (lambda h: nn.Dropout(self.dropout_rate, deterministic=not train)(h))
+            if self.dropout_rate
+            else (lambda h: h)
+        )
+        B, T, _ = x.shape
+        hd = self.dim // self.n_heads
+
+        def self_attn(h):
+            q = nn.Dense(self.dim, name="q_proj")(h).reshape(B, T, self.n_heads, hd)
+            k = nn.Dense(self.dim, name="k_proj")(h).reshape(B, T, self.n_heads, hd)
+            v = nn.Dense(self.dim, name="v_proj")(h).reshape(B, T, self.n_heads, hd)
+            out = dot_product_attention(q, k, v, causal=True, backend=self.backend)
+            return nn.Dense(self.dim, name="o_proj")(out.reshape(B, T, self.dim))
+
+        def mlp(h):
+            h = nn.Dense(self.mlp_dim, name="fc1")(h)
+            h = nn.gelu(h)
+            return nn.Dense(self.dim, name="fc2")(h)
+
+        x = x + drop(self_attn(nn.LayerNorm(name="norm1")(x)))
+        x = x + drop(
+            CrossAttention(self.dim, self.n_heads, name="cross")(
+                nn.LayerNorm(name="norm2")(x), memory
+            )
+        )
+        x = x + drop(mlp(nn.LayerNorm(name="norm3")(x)))
+        return x
+
+
+class Seq2Seq(nn.Module):
+    vocab_size: int = 32128
+    dim: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    src_len: int = 512
+    tgt_len: int = 512
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    attention: str = "xla"
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        """tokens: [B, src_len + tgt_len] packed stream → logits over the
+        SAME layout (source positions emit zeros; labels there are -100)."""
+        src, tgt = tokens[:, : self.src_len], tokens[:, self.src_len :]
+        embed = nn.Embed(
+            self.vocab_size,
+            self.dim,
+            name="embed",
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        src_pos = self.param(
+            "src_pos", nn.initializers.normal(0.02), (1, self.src_len, self.dim)
+        )
+        tgt_pos = self.param(
+            "tgt_pos", nn.initializers.normal(0.02), (1, self.tgt_len, self.dim)
+        )
+        h = embed(src) + src_pos[:, : src.shape[1]]
+        for i in range(self.n_layers):
+            h = EncoderBlock(
+                self.dim,
+                self.n_heads,
+                self.dim * self.mlp_ratio,
+                self.dropout_rate,
+                pre_norm=True,
+                backend=self.attention,
+                name=f"enc_{i}",
+            )(h, train=train)
+        memory = nn.LayerNorm(name="enc_norm")(h)
+
+        d = embed(tgt) + tgt_pos[:, : tgt.shape[1]]
+        for i in range(self.n_layers):
+            d = DecoderBlock(
+                self.dim,
+                self.n_heads,
+                self.dim * self.mlp_ratio,
+                self.dropout_rate,
+                backend=self.attention,
+                name=f"dec_{i}",
+            )(d, memory, train=train)
+        d = nn.LayerNorm(name="dec_norm")(d)
+        logits = embed.attend(d.astype(jnp.float32))
+        zeros = jnp.zeros(
+            (tokens.shape[0], src.shape[1], self.vocab_size), logits.dtype
+        )
+        return jnp.concatenate([zeros, logits], axis=1)
+
+
+@register("seq2seq")
+def build_seq2seq(config: dict) -> ModelBundle:
+    config = dict(config)
+    preset = config.pop("preset", None)
+    if preset is not None and preset not in PRESETS:
+        raise ValueError(f"unknown seq2seq preset {preset!r}; known: {sorted(PRESETS)}")
+    base = dict(PRESETS.get(preset, PRESETS["small"]))
+    base.update({k: v for k, v in config.items() if v is not None})
+    module = Seq2Seq(
+        vocab_size=int(base.get("vocab_size", 32128)),
+        dim=int(base.get("dim", 512)),
+        n_layers=int(base.get("n_layers", 6)),
+        n_heads=int(base.get("n_heads", 8)),
+        src_len=int(base.get("src_len", 512)),
+        tgt_len=int(base.get("tgt_len", 512)),
+        mlp_ratio=int(base.get("mlp_ratio", 4)),
+        dropout_rate=float(base.get("dropout_rate", 0.0)),
+        attention=str(base.get("attention", "xla")),
+    )
+    return ModelBundle(
+        name="seq2seq",
+        module=module,
+        example_inputs=i32_tokens(module.src_len + module.tgt_len),
+        loss="masked_lm",
+        task="mlm",
+        sharding_rules=ENCODER_RULES
+        + (
+            (r"embed/embedding", (None, ("model", "fsdp"))),
+        ),
+    )

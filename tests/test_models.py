@@ -166,3 +166,67 @@ def test_graft_entry():
     out = jax.jit(fn)(*args)
     assert out.shape == (8, 128, 4096)
     g.dryrun_multichip(8)
+
+
+def test_seq2seq_trains_reversal_task(tmp_home):
+    """Encoder-decoder learns the reversal task: loss descends well below
+    uniform (log 1024 ≈ 6.93) and the decoder actually uses cross-attention
+    (source-position logits are zeroed and ignored via -100)."""
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="seq2seq",
+            config={"preset": "tiny-test", "src_len": 16, "tgt_len": 16},
+        ),
+        data=V1DataSpec(
+            name="synthetic_seq2seq",
+            batch_size=32,
+            config={"src_len": 16, "tgt_len": 16, "vocab_size": 1024},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=3e-3),
+        # curve (verified on CPU): ~6.9 uniform → ~6.4 @50 → ~4.0 @75 →
+        # ~1.2 @100; 80 steps with margin distinguishes learning from noise
+        train=V1TrainSpec(steps=80, log_every=80, precision="float32"),
+    )
+    result = Trainer(program, mesh_axes={"data": -1}).run()
+    last = result.history[-1]
+    assert last["loss"] == last["loss"]
+    assert last["loss"] < 6.0, f"no learning signal: {last['loss']}"
+
+
+@pytest.mark.slow
+def test_seq2seq_trains_tp_mesh(tmp_home):
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="seq2seq",
+            config={"preset": "tiny-test", "src_len": 16, "tgt_len": 16},
+        ),
+        data=V1DataSpec(
+            name="synthetic_seq2seq",
+            batch_size=16,
+            config={"src_len": 16, "tgt_len": 16, "vocab_size": 1024},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=1e-3),
+        train=V1TrainSpec(steps=4, log_every=4, precision="float32"),
+    )
+    result = Trainer(
+        program, mesh_axes={"data": 2, "fsdp": 2, "model": 2}
+    ).run()
+    assert result.history[-1]["loss"] == result.history[-1]["loss"]
