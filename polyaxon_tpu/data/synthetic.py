@@ -140,8 +140,9 @@ def synthetic_mlm(batch_size, config, seed, process_index):
 def _seq2seq_stream(batch_size, src_len, tgt_len, vocab, seed, process_index):
     """Reversal task packed for models/seq2seq.py: the target is the source
     reversed — learnable via cross-attention, impossible for a bag-of-words
-    shortcut. Stream layout: inputs [src | BOS + tgt[:-1]], labels
-    [-100...| tgt] (loss only on decoder positions)."""
+    shortcut. Stream layout: inputs [src | BOS + tgt[:-1]] (width
+    src_len + tgt_len), labels [B, tgt_len] aligned with the decoder
+    logits."""
     rng = np.random.default_rng(seed * 1000003 + process_index + 41)
     bos = 1
     while True:
@@ -151,16 +152,17 @@ def _seq2seq_stream(batch_size, src_len, tgt_len, vocab, seed, process_index):
             [np.full((batch_size, 1), bos), tgt[:, :-1]], axis=1
         )
         inputs = np.concatenate([src, tgt_in], axis=1).astype(np.int32)
-        labels = np.concatenate(
-            [np.full((batch_size, src_len), -100), tgt], axis=1
-        ).astype(np.int32)
-        yield {"inputs": inputs, "labels": labels}
+        yield {"inputs": inputs, "labels": tgt.astype(np.int32)}
 
 
 @register_dataset("synthetic_seq2seq")
 def synthetic_seq2seq(batch_size, config, seed, process_index):
     src_len = int(config.get("src_len", 32))
     tgt_len = int(config.get("tgt_len", src_len))
+    if tgt_len > src_len:
+        raise ValueError(
+            f"reversal task needs tgt_len <= src_len, got {tgt_len} > {src_len}"
+        )
     vocab = int(config.get("vocab_size", 1024))
     return DataSpec(
         name="synthetic_seq2seq",

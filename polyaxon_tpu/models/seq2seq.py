@@ -7,9 +7,11 @@ LM (transformer.py), encoder MLM (bert.py), ViT (vit.py), and this
 encoder-decoder.
 
 Batch schema trick: one packed token stream per example —
-`[src_0..src_{S-1}, tgt_in_0..tgt_in_{T-1}]` with labels `-100` on the
-source span — so the generic trainer and the `masked_lm` loss work
-unchanged (loss only lands on decoder positions). The split point is
+`[src_0..src_{S-1}, tgt_in_0..tgt_in_{T-1}]` — while labels cover ONLY the
+decoder span `[B, tgt_len]`, and the model returns only decoder logits
+`[B, tgt_len, V]`. The generic trainer and the `masked_lm` loss work
+unchanged on that aligned pair, and no full-vocab logits are ever
+materialized (or log-softmaxed) for source positions. The split point is
 static config (`src_len`), keeping shapes XLA-friendly.
 
 Decoder blocks: pre-LN causal self-attention → cross-attention over the
@@ -39,9 +41,11 @@ PRESETS = {
 
 
 class CrossAttention(nn.Module):
+    """Always the xla backend: the blockwise kernels assume S_q == S_kv,
+    and cross-attention is the one place that never holds."""
+
     dim: int
     n_heads: int
-    backend: str = "xla"
 
     @nn.compact
     def __call__(self, x, memory):
@@ -111,8 +115,8 @@ class Seq2Seq(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
-        """tokens: [B, src_len + tgt_len] packed stream → logits over the
-        SAME layout (source positions emit zeros; labels there are -100)."""
+        """tokens: [B, src_len + tgt_len] packed stream → decoder logits
+        [B, tgt_len, vocab] (labels align with the decoder span only)."""
         src, tgt = tokens[:, : self.src_len], tokens[:, self.src_len :]
         embed = nn.Embed(
             self.vocab_size,
@@ -150,11 +154,7 @@ class Seq2Seq(nn.Module):
                 name=f"dec_{i}",
             )(d, memory, train=train)
         d = nn.LayerNorm(name="dec_norm")(d)
-        logits = embed.attend(d.astype(jnp.float32))
-        zeros = jnp.zeros(
-            (tokens.shape[0], src.shape[1], self.vocab_size), logits.dtype
-        )
-        return jnp.concatenate([zeros, logits], axis=1)
+        return embed.attend(d.astype(jnp.float32))
 
 
 @register("seq2seq")
