@@ -14,7 +14,7 @@ model is in-repo and TPU-shaped:
 - `scan_layers`: stack the blocks with `nn.scan` so compile time is O(1) in
   depth (XLA sees one block body; params gain a leading layer axis).
 - Attention backend selectable: `xla` (einsum softmax, fine for short seq),
-  `flash` (Pallas blockwise kernel, ops/flash_attention.py), `ring`
+  `flash` (Pallas blockwise kernel, ops/flash_attention.py), `ring`, `ulysses`
   (context-parallel blockwise over the `context` axis, parallel/ring.py).
 - Optional LoRA (`lora_rank > 0`): frozen base kernels + trainable A/B
   adapters on all projections; the trainer masks the optimizer to adapter
@@ -46,7 +46,7 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dropout_rate: float = 0.0
-    attention: str = "xla"  # xla | flash | ring
+    attention: str = "xla"  # xla | flash | ring | ulysses
     attention_block: int = 512  # kv block size for flash/ring backends
     lora_rank: int = 0
     lora_alpha: float = 16.0

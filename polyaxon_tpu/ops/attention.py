@@ -28,6 +28,10 @@ def dot_product_attention(
         from ..parallel.ring import ring_attention
 
         return ring_attention(q, k, v, block_kv=block_kv, causal=causal)
+    if backend == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, block_kv=block_kv, causal=causal)
     if backend != "xla":
         raise ValueError(f"unknown attention backend {backend!r}")
     hd = q.shape[-1]

@@ -1,0 +1,90 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second context-parallel strategy SURVEY.md §5 commits to, next to ring
+attention: instead of rotating KV chunks around an ICI ring (N-1 hops,
+compute overlapped), TWO all-to-alls flip the sharding from
+sequence-sharded [B, S/c, H, D] to head-sharded [B, S, H/c, D], run plain
+(flash) attention on the full sequence locally, and flip back.
+
+Trade-off vs ring (why both exist): Ulysses moves each token exactly twice
+over the fabric regardless of ring size — lower traffic and no
+per-hop softmax merges, the better choice when S_local² compute is small
+relative to bandwidth (short-ish sequences, many chips). Ring keeps heads
+whole — the only option when heads don't divide the context degree, and
+the better overlap profile at very long S. Select per model with
+`attention: ulysses` / `attention: ring`.
+
+Constraint: local head count must divide by the context degree (heads are
+what gets scattered)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from .mesh import BATCH_AXES
+from .ring import current_mesh
+
+
+def _ulysses_body(q, k, v, axis_name: str, causal: bool, block_kv: int):
+    from ..ops.flash_attention import flash_attention
+
+    def seq_to_heads(x):  # [B, S/c, H, D] → [B, S, H/c, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):  # [B, S, H/c, D] → [B, S/c, H, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o = flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+    return heads_to_seq(o)
+
+
+def ulysses_attention(
+    q, k, v, *, axis_name: str = "context", block_kv: int = 512, causal: bool = True
+):
+    """Attention with Q/K/V sequence-sharded over `axis_name`.
+
+    q/k/v: [B, S, H, D] global shapes (same head count — expand GQA first).
+    Falls back to single-device flash attention when the mesh has no
+    (non-trivial) context axis, mirroring ring_attention's contract."""
+    mesh = current_mesh()
+    n = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
+    if n <= 1:
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+
+    model_deg = mesh.shape.get("model", 1)
+    local_heads = q.shape[2] // model_deg if model_deg > 1 else q.shape[2]
+    if local_heads % n != 0:
+        raise ValueError(
+            f"ulysses needs local head count {local_heads} divisible by the "
+            f"context degree {n} (heads are scattered); use attention: ring "
+            "for this shape"
+        )
+    batch = tuple(ax for ax in BATCH_AXES if mesh.shape.get(ax, 1) > 1) or None
+    head = "model" if model_deg > 1 else None
+    spec = P(batch, axis_name, head, None)
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    body = partial(
+        _ulysses_body, axis_name=axis_name, causal=causal, block_kv=block_kv
+    )
+    try:
+        # the Pallas flash kernel inside the map doesn't declare varying
+        # mesh axes; skip the vma check (newer jax only)
+        inner = shard_map(body, check_vma=False, **kwargs)
+    except TypeError:  # older jax: kwarg absent, check doesn't exist either
+        inner = shard_map(body, **kwargs)
+    return inner(q, k, v)

@@ -119,3 +119,76 @@ def test_trainer_ring_attention_end_to_end():
     trainer = Trainer(prog, mesh_axes={"data": 2, "context": 4})
     result = trainer.run()
     assert np.isfinite(result.history[-1]["loss"])
+
+
+# --------------------------------------------------------------- ulysses
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_xla(causal):
+    """All-to-all sequence parallelism == single-device attention."""
+    from polyaxon_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(S=64)  # H=8 divisible by context=4
+        ref = dot_product_attention(q, k, v, causal=causal, backend="xla")
+        out = ulysses_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from polyaxon_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = build_mesh({"context": 8})  # H=8 heads... use S small
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(S=64)
+        # H=8, context=8: divisible — force the error with a model axis? use
+        # a 3-head tensor instead
+        import jax.numpy as jnp
+
+        q3, k3, v3 = (x[:, :, :6] for x in (q, k, v))  # 6 heads vs ctx 8
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q3, k3, v3)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ulysses_falls_back_without_context_axis():
+    from polyaxon_tpu.parallel.ulysses import ulysses_attention
+
+    set_current_mesh(None)
+    q, k, v = _qkv(S=32)
+    ref = dot_product_attention(q, k, v, causal=True, backend="flash")
+    np.testing.assert_allclose(ulysses_attention(q, k, v), ref, atol=1e-6)
+
+
+def test_trainer_ulysses_attention_end_to_end(tmp_home):
+    """Full train step with attention=ulysses on a context mesh."""
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="transformer_lm",
+            config={"preset": "tiny", "seq_len": 64, "attention": "ulysses",
+                    "n_heads": 8, "n_kv_heads": 8},
+        ),
+        data=V1DataSpec(
+            name="synthetic_text",
+            batch_size=8,
+            config={"seq_len": 64, "vocab_size": 4096},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=1e-3),
+        train=V1TrainSpec(steps=3, log_every=3, precision="float32"),
+    )
+    result = Trainer(program, mesh_axes={"context": 2, "data": 4}).run()
+    assert result.history[-1]["loss"] == result.history[-1]["loss"]
