@@ -165,6 +165,7 @@ def test_ulysses_falls_back_without_context_axis():
     np.testing.assert_allclose(ulysses_attention(q, k, v), ref, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_trainer_ulysses_attention_end_to_end(tmp_home):
     """Full train step with attention=ulysses on a context mesh."""
     from polyaxon_tpu.runtime.trainer import Trainer
