@@ -213,6 +213,25 @@ def ops_stop(uid):
     click.echo(f"{uid[:8]} {status}")
 
 
+@ops.command("delete")
+@click.option("-uid", "--uid", required=True)
+@click.option("--yes", is_flag=True, help="skip confirmation")
+def ops_delete(uid, yes):
+    """Delete a finished run's data (metrics, logs, outputs) permanently."""
+    store = RunStore()
+    try:
+        uid = store.resolve(uid)
+    except KeyError as e:
+        raise click.ClickException(str(e).strip("'\""))
+    if not yes:
+        click.confirm(f"permanently delete run {uid[:8]}?", abort=True)
+    try:
+        store.delete_run(uid)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"{uid[:8]} deleted")
+
+
 def _clone_cmd(uid, kind, eager):
     from ..client import ClientError, RunClient
     from ..compiler.resolver import CompilationError

@@ -43,11 +43,14 @@ class _HttpTransport:
             raise ClientError(f"GET {path}: {e.reason}") from e
 
     def post(self, path: str, body: Optional[dict] = None) -> Any:
+        return self.request("POST", path, body)
+
+    def request(self, method: str, path: str, body: Optional[dict] = None) -> Any:
         req = urllib.request.Request(
             self.base_url + path,
             data=json.dumps(body or {}).encode(),
             headers={"Content-Type": "application/json"},
-            method="POST",
+            method=method,
         )
         try:
             with urllib.request.urlopen(req) as r:
@@ -58,9 +61,9 @@ class _HttpTransport:
                 detail = ": " + json.loads(e.read()).get("error", "")
             except Exception:  # noqa: BLE001 — detail is best-effort
                 pass
-            raise ClientError(f"POST {path}: HTTP {e.code}{detail}") from e
+            raise ClientError(f"{method} {path}: HTTP {e.code}{detail}") from e
         except urllib.error.URLError as e:
-            raise ClientError(f"POST {path}: {e.reason}") from e
+            raise ClientError(f"{method} {path}: {e.reason}") from e
 
 
 class RunClient:
@@ -104,6 +107,13 @@ class RunClient:
             self._http.post(f"/runs/{uuid}/stop")
             return
         self.store.request_stop(self.store.resolve(uuid))
+
+    def delete(self, uuid: str):
+        """Permanently delete a finished run's data."""
+        if self._http:
+            self._http.request("DELETE", f"/runs/{uuid}")
+            return
+        self.store.delete_run(self.store.resolve(uuid))
 
     # ------------------------------------------------- restart/resume/copy
     def _op_from_run(self, src_uuid: str, suffix: str) -> V1Operation:

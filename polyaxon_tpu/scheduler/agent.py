@@ -93,9 +93,12 @@ class Agent:
     def _process(self, entry: dict) -> str:
         from ..schemas.lifecycle import DONE_STATUSES
 
-        # a remote client may have stopped the run while it sat in the queue
-        # (str-enum: plain string membership matches the enum set)
-        current = self.store.get_status(entry["uuid"]).get("status")
+        # a remote client may have stopped — or deleted — the run while it
+        # sat in the queue (str-enum: string membership matches the set)
+        status_data = self.store.get_status(entry["uuid"])
+        if not status_data:
+            return "deleted"  # run gone: never resurrect it
+        current = status_data.get("status")
         if current in DONE_STATUSES:
             return current
         op = V1Operation.model_validate(entry["payload"]["operation"])

@@ -70,7 +70,7 @@ class RunStore:
                 "meta": meta or {},
             },
         )
-        with (self.home / "index.jsonl").open("a") as f:
+        with self._index_lock(), (self.home / "index.jsonl").open("a") as f:
             f.write(
                 json.dumps(
                     {
@@ -106,6 +106,62 @@ class RunStore:
 
     def get_status(self, run_uuid: str) -> dict:
         return _read_json(self.run_dir(run_uuid) / "status.json") or {}
+
+    def _index_lock(self):
+        """Cross-process lock serializing index.jsonl appends and rewrites.
+        A dedicated lock file (never replaced) avoids the stale-inode race
+        of locking the index itself across os.replace."""
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def lock():
+            with open(self.home / "index.lock", "w") as f:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(f, fcntl.LOCK_UN)
+
+        return lock()
+
+    def delete_run(self, run_uuid: str) -> None:
+        """Remove a run's directory, queue entries, and index entry. Refuses
+        while the run is in an active state — stop it first. Data removal
+        failures propagate BEFORE the index is touched (no silent orphans)."""
+        import shutil
+
+        from ..schemas.lifecycle import DONE_STATUSES
+
+        status = self.get_status(run_uuid).get("status")
+        if status and status not in DONE_STATUSES and status != V1Statuses.CREATED:
+            raise ValueError(
+                f"run {run_uuid[:8]} is {status}; stop it before deleting"
+            )
+        # a stopped-while-queued run still has a queue entry; without this a
+        # draining agent would resurrect the deleted run
+        from ..scheduler.queue import QueueRegistry
+
+        registry = QueueRegistry(self)
+        for name in registry.names():
+            registry.get(name).remove(run_uuid)
+        run_dir = self.run_dir(run_uuid)
+        if run_dir.exists():
+            shutil.rmtree(run_dir)  # errors propagate: index stays intact
+        index = self.home / "index.jsonl"
+        if index.exists():
+            # under the shared index lock (held by create_run's append too)
+            # + atomic replace: concurrent appends are never lost and a
+            # crash mid-rewrite never truncates the index
+            with self._index_lock():
+                kept = [
+                    rec
+                    for rec in _read_jsonl(index)
+                    if rec.get("uuid") != run_uuid
+                ]
+                tmp = index.with_suffix(".jsonl.tmp")
+                tmp.write_text("".join(json.dumps(r) + "\n" for r in kept))
+                os.replace(tmp, index)
 
     def set_meta(self, run_uuid: str, **entries):
         """Merge keys into the run's status meta (attempt counters etc.)."""

@@ -164,6 +164,24 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             self._send(500, _json_bytes({"error": str(e)}))
 
+    def do_DELETE(self):  # noqa: N802 (stdlib naming)
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        store = self.store
+        try:
+            if len(parts) == 2 and parts[0] == "runs":
+                # no status.json check: stale index entries (dir lost
+                # out-of-band) must remain purgeable over the API
+                uuid = store.resolve(parts[1])
+                store.delete_run(uuid)
+                return self._send(200, _json_bytes({"deleted": uuid}))
+            self._not_found(self.path)
+        except KeyError as e:
+            self._not_found(str(e))
+        except ValueError as e:  # active run → 409
+            self._send(409, _json_bytes({"error": str(e)}))
+        except Exception as e:  # noqa: BLE001
+            self._send(500, _json_bytes({"error": str(e)}))
+
 
 def make_server(
     store: Optional[RunStore] = None, host: str = "127.0.0.1", port: int = 8585

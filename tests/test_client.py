@@ -259,3 +259,33 @@ def test_restart_resume_copy(tmp_home, tmp_path):
     assert clone_kinds == {"restart", "copy", "resume"}
     summary = [e for e in client.events(rs) if e.get("kind") == "run_summary"]
     assert summary  # resumed run completed and summarized
+
+
+def test_delete_run_local_and_http(tmp_home, tmp_path):
+    client = RunClient()
+    done = client.create(_op(tmp_path), queue=False)
+    queued = client.create(_op(tmp_path), queue=True)
+
+    # active (queued) runs are protected
+    with pytest.raises(ValueError, match="stop it before deleting"):
+        client.delete(queued)
+    client.stop(queued)
+
+    # deleting a stopped-but-still-queued run purges its queue entry: a
+    # later agent drain must NOT resurrect it
+    client.delete(queued)
+    from polyaxon_tpu.scheduler import Agent
+
+    Agent(store=client.store).drain()
+    assert all(r["uuid"] != queued for r in client.list())
+    queued = client.create(_op(tmp_path), queue=True)
+    client.stop(queued)
+
+    with BackgroundServer(client.store) as srv:
+        remote = RunClient(base_url=f"http://127.0.0.1:{srv.port}")
+        remote.delete(done)
+        assert all(r["uuid"] != done for r in remote.list())
+        with pytest.raises(ClientError, match="404"):
+            remote.delete(done)  # already gone
+    client.delete(queued)
+    assert client.list() == []
