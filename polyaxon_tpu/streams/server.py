@@ -62,6 +62,10 @@ class _Handler(BaseHTTPRequestHandler):
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         store = self.store
         try:
+            if not parts or parts == ["ui"]:
+                from .ui import INDEX_HTML
+
+                return self._send(200, INDEX_HTML.encode(), "text/html")
             if parts == ["healthz"]:
                 return self._send(200, _json_bytes({"status": "ok"}))
             if parts == ["runs"]:

@@ -1,0 +1,82 @@
+"""Dashboard-lite: a dependency-free single page served at `/` by the
+streams service. Read-only view over the same JSON endpoints the CLI uses
+(GET /runs, /runs/<id>/status|metrics|logs) — vanilla JS polling, no build
+step, no assets. The reference ships a full web dashboard; this covers the
+daily loop (what's running, is loss moving, tail the logs) without one."""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>polyaxon-tpu</title>
+<style>
+  body { font-family: ui-monospace, SFMono-Regular, Menlo, monospace;
+         margin: 2rem; background: #0b0e14; color: #d6d6d6; }
+  h1 { font-size: 1.1rem; letter-spacing: .06em; }
+  h1 span { color: #7aa2f7; }
+  table { border-collapse: collapse; width: 100%; margin-top: 1rem; }
+  th, td { text-align: left; padding: .35rem .8rem; border-bottom: 1px solid #1f2430; }
+  th { color: #8089a6; font-weight: 600; font-size: .8rem; text-transform: uppercase; }
+  tr:hover td { background: #11151f; cursor: pointer; }
+  .succeeded { color: #9ece6a; } .failed { color: #f7768e; }
+  .running, .starting { color: #7aa2f7; } .stopped { color: #e0af68; }
+  .queued, .scheduled, .compiled, .created { color: #8089a6; }
+  #detail { margin-top: 1.5rem; border-top: 2px solid #1f2430; padding-top: 1rem; }
+  pre { background: #11151f; padding: .8rem; overflow-x: auto; max-height: 18rem; }
+  .uuid { color: #565f89; }
+  #metrics td, #metrics th { font-size: .85rem; }
+  .muted { color: #565f89; font-size: .8rem; }
+</style>
+</head>
+<body>
+<h1><span>polyaxon-tpu</span> runs <span class="muted" id="ts"></span></h1>
+<table id="runs"><thead>
+<tr><th>run</th><th>name</th><th>project</th><th>status</th></tr>
+</thead><tbody></tbody></table>
+<div id="detail" hidden>
+  <h1 id="d-title"></h1>
+  <table id="metrics"><thead></thead><tbody></tbody></table>
+  <pre id="logs"></pre>
+</div>
+<script>
+let selected = null;
+async function j(p) { const r = await fetch(p); return r.json(); }
+function fmt(v) { return typeof v === "number" ? v.toPrecision(5) : v; }
+async function refresh() {
+  const runs = await j("/runs");
+  const tb = document.querySelector("#runs tbody");
+  tb.innerHTML = "";
+  for (const r of runs) {
+    const tr = document.createElement("tr");
+    tr.innerHTML = `<td class="uuid">${r.uuid.slice(0,8)}</td>` +
+      `<td>${r.name || ""}</td><td>${r.project || ""}</td>` +
+      `<td class="${r.status}">${r.status}</td>`;
+    tr.onclick = () => { selected = r.uuid; detail(); };
+    tb.appendChild(tr);
+  }
+  document.getElementById("ts").textContent = new Date().toLocaleTimeString();
+  if (selected) detail();
+}
+async function detail() {
+  const d = document.getElementById("detail");
+  d.hidden = false;
+  const [status, metrics, logs] = await Promise.all([
+    j(`/runs/${selected}/status`), j(`/runs/${selected}/metrics`),
+    j(`/runs/${selected}/logs`)]);
+  document.getElementById("d-title").textContent =
+    `${selected.slice(0,8)} — ${status.status}`;
+  const last = metrics.slice(-12);
+  const keys = last.length ? Object.keys(last[0]).filter(k => k !== "ts") : [];
+  document.querySelector("#metrics thead").innerHTML =
+    "<tr>" + keys.map(k => `<th>${k}</th>`).join("") + "</tr>";
+  document.querySelector("#metrics tbody").innerHTML = last.map(m =>
+    "<tr>" + keys.map(k => `<td>${fmt(m[k])}</td>`).join("") + "</tr>").join("");
+  const text = logs.logs || "";
+  document.getElementById("logs").textContent = text.split("\\n").slice(-40).join("\\n");
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""
