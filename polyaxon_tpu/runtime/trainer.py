@@ -230,8 +230,21 @@ class Trainer:
             )
             return logits, updates, aux
 
-        if use_remat:
-            apply = jax.checkpoint(apply)
+        if use_remat or tspec.remat_policy:
+            policies = {
+                None: None,  # jax default: save nothing
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch": (
+                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+                ),
+            }
+            policy = policies[tspec.remat_policy]
+            apply = (
+                jax.checkpoint(apply, policy=policy)
+                if policy is not None
+                else jax.checkpoint(apply)
+            )
 
         param_dtype = self.param_dtype
 

@@ -88,6 +88,11 @@ class V1TrainSpec(BaseSchema):
     seed: int | str = 0
     precision: Literal["bfloat16", "float32", "mixed"] = "mixed"
     remat: Optional[bool] = None
+    # what the backward pass may keep from the forward (jax.checkpoint
+    # policy): nothing = recompute all (max HBM savings), dots = keep matmul
+    # outputs (recompute cheap elementwise only — the usual TPU sweet spot),
+    # dots_no_batch = keep only non-batch matmuls (Megatron-style)
+    remat_policy: Optional[Literal["nothing", "dots", "dots_no_batch"]] = None
     donate_state: bool = True
     loss: Optional[str] = None
     # microbatch gradient accumulation: the per-step batch is split into

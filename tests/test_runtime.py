@@ -263,3 +263,32 @@ def test_grad_accum_trains_on_mesh(tmp_home):
     first, last = result.history[0], result.history[-1]
     assert last["loss"] == last["loss"]  # finite
     assert last["loss"] < 1.6  # descending on the learnable stream
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["nothing", "dots", "dots_no_batch"])
+def test_remat_policies_compile_and_train(tmp_home, policy):
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="transformer_lm", config={"preset": "tiny", "seq_len": 32}
+        ),
+        data=V1DataSpec(
+            name="synthetic_text", batch_size=8,
+            config={"seq_len": 32, "vocab_size": 4096},
+        ),
+        optimizer=V1OptimizerSpec(name="adamw", learning_rate=1e-3),
+        train=V1TrainSpec(
+            steps=2, log_every=2, precision="float32", remat_policy=policy
+        ),
+    )
+    result = Trainer(program, mesh_axes={"data": -1}).run()
+    assert result.history[-1]["loss"] == result.history[-1]["loss"]
