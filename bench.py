@@ -256,12 +256,60 @@ def _spawn(env_extra: dict, timeout: float):
     return None, f"exit code {proc.returncode}, no JSON line"
 
 
+def _probe_backend(timeout: float) -> bool:
+    """Cheap child that only touches jax.devices(): when the TPU tunnel is
+    healthy this returns in seconds; when it is down, backend init blocks
+    ~25 min — the probe's kill converts that into a fast CPU-fallback
+    decision instead of burning the whole bench budget."""
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "print('probe-ok', d.platform, d.device_kind)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, POLYAXON_BENCH_CHILD=""),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    ok = proc.returncode == 0 and "probe-ok" in (proc.stdout or "")
+    if ok:
+        print(f"bench: {proc.stdout.strip()}", file=sys.stderr)
+    return ok
+
+
 def main():
     if os.environ.get("POLYAXON_BENCH_CHILD") == "1":
         _child_main()
         return
 
     deadline = float(os.environ.get("POLYAXON_BENCH_TIMEOUT", "900"))
+    probe_s = float(os.environ.get("POLYAXON_BENCH_PROBE_TIMEOUT", "240"))
+    if not _probe_backend(probe_s):
+        print(
+            f"bench: backend probe failed within {probe_s:.0f}s; CPU fallback",
+            file=sys.stderr,
+        )
+        line, err2 = _spawn(
+            {"POLYAXON_JAX_PLATFORM": "cpu", "POLYAXON_NUM_CPU_DEVICES": "1"},
+            min(deadline, 600.0),
+        )
+        if line is None:
+            line = json.dumps(
+                {
+                    "metric": "transformer_tokens_per_sec",
+                    "value": 0.0,
+                    "unit": "tok/s",
+                    "vs_baseline": 0.0,
+                    "error": f"tpu: probe timeout; cpu: {err2}",
+                }
+            )
+        print(line)
+        return
     line, err = _spawn({}, deadline)
     if line is None:
         print(f"bench: native attempt failed ({err}); CPU fallback", file=sys.stderr)
