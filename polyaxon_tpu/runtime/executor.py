@@ -278,7 +278,9 @@ class Executor:
         try:
             if run.kind == "jaxjob" and run.program is not None:
                 self._run_program(compiled, resume=resume)
-            elif run.kind in ("job", "jaxjob", "service") and run.container is not None:
+            elif run.kind == "service" and run.container is not None:
+                self._run_service(compiled, timeout=timeout)
+            elif run.kind in ("job", "jaxjob") and run.container is not None:
                 self._run_container(compiled, timeout=timeout)
             elif run.kind == "dag":
                 from ..scheduler.dag import execute_dag
@@ -628,6 +630,66 @@ class Executor:
             os.unlink(spec_file.name)
         if code != 0:
             raise ExecutionError(f"distributed gang exited with code {code}")
+
+    def _run_service(self, compiled: CompiledOperation, timeout=None):
+        """Service semantics: the process is SUPPOSED to stay up. RUNNING
+        until a stop request lands (then terminated → STOPPED) or the
+        optional timeout expires; a service that exits by itself is a
+        FAILURE (0 or not — services don't 'finish'). Ports and run
+        identity are injected via env (POLYAXON_SERVICE_PORT[S])."""
+        import time as _time
+
+        run = compiled.run
+        store, run_uuid = self.store, compiled.run_uuid
+        c = run.container
+        cmd = list(c.command or []) + list(c.args or [])
+        if not cmd:
+            raise ExecutionError("service container has no command")
+        env = self._container_env(compiled, c)
+        ports = [int(p) for p in (getattr(run, "ports", None) or [])]
+        if ports:
+            env["POLYAXON_SERVICE_PORT"] = str(ports[0])
+            env["POLYAXON_SERVICE_PORTS"] = ",".join(str(p) for p in ports)
+        store.set_status(run_uuid, V1Statuses.RUNNING)
+        store.log_event(
+            run_uuid, "service_started", {"ports": ports, "command": cmd[0]}
+        )
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=c.working_dir or None,
+            env=env,
+        )
+        import threading
+
+        def _drain():
+            for line in iter(proc.stdout.readline, ""):
+                store.append_log(run_uuid, line.rstrip("\n"))
+
+        drain = threading.Thread(target=_drain, daemon=True)
+        drain.start()
+        deadline = _time.time() + timeout if timeout else None
+        try:
+            while proc.poll() is None:
+                status = store.get_status(run_uuid).get("status")
+                if status in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+                    raise StopRequested("service stop requested")
+                if deadline and _time.time() > deadline:
+                    raise ExecutionError(f"service exceeded timeout of {timeout}s")
+                _time.sleep(0.5)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            drain.join(timeout=5)
+        raise ExecutionError(
+            f"service exited unexpectedly with code {proc.returncode}"
+        )
 
     def _run_container(self, compiled: CompiledOperation, timeout=None):
         """Local-subprocess stand-in for the k8s pod path: runs the container

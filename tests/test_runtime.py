@@ -292,3 +292,66 @@ def test_remat_policies_compile_and_train(tmp_home, policy):
     )
     result = Trainer(program, mesh_axes={"data": -1}).run()
     assert result.history[-1]["loss"] == result.history[-1]["loss"]
+
+
+def test_service_runs_until_stopped(tmp_home, tmp_path):
+    """Services stay RUNNING until a stop lands (then STOPPED, process
+    terminated); self-exit is a failure, not success."""
+    import threading
+    import time
+
+    import yaml
+
+    from polyaxon_tpu.client import RunClient
+    from polyaxon_tpu.schemas.lifecycle import V1Statuses
+
+    def svc_op(cmd):
+        spec = {
+            "version": 1.1,
+            "kind": "operation",
+            "name": "svc",
+            "component": {
+                "kind": "component",
+                "name": "svc",
+                "run": {
+                    "kind": "service",
+                    "ports": [7777],
+                    "container": {"command": ["sh", "-c", cmd]},
+                },
+            },
+        }
+        p = tmp_path / "svc.yaml"
+        p.write_text(yaml.safe_dump(spec))
+        from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+
+        return read_polyaxonfile(str(p))
+
+    client = RunClient()
+    results = {}
+    op = svc_op('echo "serving on $POLYAXON_SERVICE_PORT"; sleep 60')
+
+    def _run():
+        results["uuid"] = client.create(op, queue=False)
+
+    t = threading.Thread(target=_run)
+    t.start()
+    deadline = time.time() + 30
+    uuid = None
+    while time.time() < deadline:
+        runs = client.list()
+        if runs and runs[0]["status"] == V1Statuses.RUNNING:
+            uuid = runs[0]["uuid"]
+            break
+        time.sleep(0.2)
+    assert uuid, "service never reached RUNNING"
+    time.sleep(1.0)
+    client.stop(uuid)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert client.get(uuid)["status"] == V1Statuses.STOPPED
+    assert "serving on 7777" in client.logs(uuid)
+
+    # a service that exits by itself FAILED, even with exit code 0
+    uuid2 = client.create(svc_op("true"), queue=False)
+    assert client.get(uuid2)["status"] == V1Statuses.FAILED
+    assert "exited unexpectedly" in client.logs(uuid2)
