@@ -288,15 +288,21 @@ def main():
         return
 
     deadline = float(os.environ.get("POLYAXON_BENCH_TIMEOUT", "900"))
-    probe_s = float(os.environ.get("POLYAXON_BENCH_PROBE_TIMEOUT", "240"))
+    t_start = time.monotonic()
+    # probe shares the overall budget: never exceed POLYAXON_BENCH_TIMEOUT
+    probe_s = min(
+        float(os.environ.get("POLYAXON_BENCH_PROBE_TIMEOUT", "240")),
+        max(30.0, deadline / 3),
+    )
     if not _probe_backend(probe_s):
         print(
             f"bench: backend probe failed within {probe_s:.0f}s; CPU fallback",
             file=sys.stderr,
         )
+        remaining = max(120.0, deadline - (time.monotonic() - t_start))
         line, err2 = _spawn(
             {"POLYAXON_JAX_PLATFORM": "cpu", "POLYAXON_NUM_CPU_DEVICES": "1"},
-            min(deadline, 600.0),
+            min(remaining, 600.0),
         )
         if line is None:
             line = json.dumps(
@@ -310,7 +316,7 @@ def main():
             )
         print(line)
         return
-    line, err = _spawn({}, deadline)
+    line, err = _spawn({}, max(120.0, deadline - (time.monotonic() - t_start)))
     if line is None:
         print(f"bench: native attempt failed ({err}); CPU fallback", file=sys.stderr)
         line, err2 = _spawn(

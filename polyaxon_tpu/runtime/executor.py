@@ -631,6 +631,25 @@ class Executor:
         if code != 0:
             raise ExecutionError(f"distributed gang exited with code {code}")
 
+    def _spawn_container(
+        self, compiled: CompiledOperation, c, extra_env: Optional[dict] = None
+    ) -> subprocess.Popen:
+        """One launch recipe for main containers and services."""
+        cmd = list(c.command or []) + list(c.args or [])
+        if not cmd:
+            raise ExecutionError("container has no command")
+        env = self._container_env(compiled, c)
+        if extra_env:
+            env.update(extra_env)
+        return subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=c.working_dir or None,
+            env=env,
+        )
+
     def _run_service(self, compiled: CompiledOperation, timeout=None):
         """Service semantics: the process is SUPPOSED to stay up. RUNNING
         until a stop request lands (then terminated → STOPPED) or the
@@ -641,27 +660,14 @@ class Executor:
 
         run = compiled.run
         store, run_uuid = self.store, compiled.run_uuid
-        c = run.container
-        cmd = list(c.command or []) + list(c.args or [])
-        if not cmd:
-            raise ExecutionError("service container has no command")
-        env = self._container_env(compiled, c)
         ports = [int(p) for p in (getattr(run, "ports", None) or [])]
+        extra_env = {}
         if ports:
-            env["POLYAXON_SERVICE_PORT"] = str(ports[0])
-            env["POLYAXON_SERVICE_PORTS"] = ",".join(str(p) for p in ports)
+            extra_env["POLYAXON_SERVICE_PORT"] = str(ports[0])
+            extra_env["POLYAXON_SERVICE_PORTS"] = ",".join(str(p) for p in ports)
         store.set_status(run_uuid, V1Statuses.RUNNING)
-        store.log_event(
-            run_uuid, "service_started", {"ports": ports, "command": cmd[0]}
-        )
-        proc = subprocess.Popen(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            cwd=c.working_dir or None,
-            env=env,
-        )
+        store.log_event(run_uuid, "service_started", {"ports": ports})
+        proc = self._spawn_container(compiled, run.container, extra_env)
         import threading
 
         def _drain():
@@ -697,20 +703,8 @@ class Executor:
         scheduler/converter.py is the cluster path)."""
         run = compiled.run
         store, run_uuid = self.store, compiled.run_uuid
-        c = run.container
-        cmd = list(c.command or []) + list(c.args or [])
-        if not cmd:
-            raise ExecutionError("container has no command")
-        env = self._container_env(compiled, c)
         store.set_status(run_uuid, V1Statuses.RUNNING)
-        proc = subprocess.Popen(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            cwd=c.working_dir or None,
-            env=env,
-        )
+        proc = self._spawn_container(compiled, run.container)
         deadline = time.time() + timeout if timeout else None
         for line in iter(proc.stdout.readline, ""):
             store.append_log(run_uuid, line.rstrip("\n"))

@@ -232,19 +232,14 @@ class Trainer:
 
         if use_remat or tspec.remat_policy:
             policies = {
-                None: None,  # jax default: save nothing
+                None: None,  # jax.checkpoint's default: save nothing
                 "nothing": jax.checkpoint_policies.nothing_saveable,
                 "dots": jax.checkpoint_policies.checkpoint_dots,
                 "dots_no_batch": (
                     jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
                 ),
             }
-            policy = policies[tspec.remat_policy]
-            apply = (
-                jax.checkpoint(apply, policy=policy)
-                if policy is not None
-                else jax.checkpoint(apply)
-            )
+            apply = jax.checkpoint(apply, policy=policies[tspec.remat_policy])
 
         param_dtype = self.param_dtype
 

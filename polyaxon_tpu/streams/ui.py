@@ -41,16 +41,20 @@ INDEX_HTML = """<!doctype html>
 <script>
 let selected = null;
 async function j(p) { const r = await fetch(p); return r.json(); }
-function fmt(v) { return typeof v === "number" ? v.toPrecision(5) : v; }
+function esc(v) {  // all server strings are untrusted (run names from specs)
+  return String(v ?? "").replace(/[&<>"']/g,
+    c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+function fmt(v) { return typeof v === "number" ? v.toPrecision(5) : esc(v); }
 async function refresh() {
   const runs = await j("/runs");
   const tb = document.querySelector("#runs tbody");
   tb.innerHTML = "";
   for (const r of runs) {
     const tr = document.createElement("tr");
-    tr.innerHTML = `<td class="uuid">${r.uuid.slice(0,8)}</td>` +
-      `<td>${r.name || ""}</td><td>${r.project || ""}</td>` +
-      `<td class="${r.status}">${r.status}</td>`;
+    tr.innerHTML = `<td class="uuid">${esc(r.uuid).slice(0,8)}</td>` +
+      `<td>${esc(r.name)}</td><td>${esc(r.project)}</td>` +
+      `<td class="${esc(r.status)}">${esc(r.status)}</td>`;
     tr.onclick = () => { selected = r.uuid; detail(); };
     tb.appendChild(tr);
   }
@@ -68,7 +72,7 @@ async function detail() {
   const last = metrics.slice(-12);
   const keys = last.length ? Object.keys(last[0]).filter(k => k !== "ts") : [];
   document.querySelector("#metrics thead").innerHTML =
-    "<tr>" + keys.map(k => `<th>${k}</th>`).join("") + "</tr>";
+    "<tr>" + keys.map(k => `<th>${esc(k)}</th>`).join("") + "</tr>";
   document.querySelector("#metrics tbody").innerHTML = last.map(m =>
     "<tr>" + keys.map(k => `<td>${fmt(m[k])}</td>`).join("") + "</tr>").join("");
   const text = logs.logs || "";
