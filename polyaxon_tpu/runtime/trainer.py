@@ -134,9 +134,39 @@ class Trainer:
         )
         self._build_step()
 
+    def _validate_mesh_fit(self):
+        """Friendly config errors instead of opaque XLA sharding failures:
+        every mesh axis must divide the model/data dimension it splits."""
+        mesh, cfg = self.mesh, getattr(self.bundle.module, "cfg", None)
+
+        def check(axis: int, dim: int, what: str):
+            if axis > 1 and dim % axis != 0:
+                raise ValueError(
+                    f"mesh axis mismatch: {what} ({dim}) is not divisible by "
+                    f"the mesh's {axis}-way split — adjust the mesh or the model"
+                )
+
+        if cfg is not None:
+            model_deg = mesh.shape.get("model", 1)
+            check(model_deg, getattr(cfg, "n_heads", model_deg), "n_heads")
+            ctx = mesh.shape.get("context", 1)
+            check(ctx, getattr(cfg, "seq_len", ctx), "seq_len")
+            pipe = mesh.shape.get("pipeline", 1)
+            check(pipe, getattr(cfg, "n_layers", pipe), "n_layers")
+            exp = mesh.shape.get("expert", 1)
+            n_experts = getattr(cfg, "n_experts", 0) or 0
+            if exp > 1:
+                if n_experts == 0:
+                    raise ValueError(
+                        "mesh declares an expert axis but the model has no "
+                        "experts (set model.config.n_experts)"
+                    )
+                check(exp, n_experts, "n_experts")
+
     # -------------------------------------------------------------- setup
     def _build_step(self):
         bundle, mesh, tspec = self.bundle, self.mesh, self.tspec
+        self._validate_mesh_fit()
         global_batch = self.data.batch_size * jax.process_count()
         if global_batch % local_batch_slice(mesh) != 0:
             raise ValueError(

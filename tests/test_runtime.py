@@ -355,3 +355,31 @@ def test_service_runs_until_stopped(tmp_home, tmp_path):
     uuid2 = client.create(svc_op("true"), queue=False)
     assert client.get(uuid2)["status"] == V1Statuses.FAILED
     assert "exited unexpectedly" in client.logs(uuid2)
+
+
+def test_mesh_model_axis_mismatch_friendly_error(tmp_home):
+    """A model axis that doesn't divide n_heads fails with a config error,
+    not an opaque XLA sharding crash."""
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1Program,
+    )
+
+    program = V1Program(
+        model=V1ModelSpec(
+            name="transformer_lm",
+            config={"dim": 96, "n_layers": 2, "n_heads": 3, "n_kv_heads": 3,
+                    "vocab_size": 256, "seq_len": 32},
+        ),
+        data=V1DataSpec(
+            name="synthetic_text", batch_size=8,
+            config={"seq_len": 32, "vocab_size": 256},
+        ),
+    )
+    with pytest.raises(ValueError, match="n_heads .3. is not divisible"):
+        Trainer(program, mesh_axes={"model": 2, "data": 4})
+
+    with pytest.raises(ValueError, match="no\\s+.?experts"):
+        Trainer(program, mesh_axes={"expert": 2, "data": 4})
