@@ -53,13 +53,37 @@ def _params_to_dict(params):
 @click.option("--project", default="default")
 @click.option("--watch/--no-watch", default=False, help="stream logs after submit")
 def run(fpath, params, name, project, watch):
-    """Submit a polyaxonfile for execution (local executor)."""
+    """Submit a polyaxonfile for execution. With a remote control plane
+    configured (`polyaxon config set streams_url http://host:8585` or
+    POLYAXON_STREAMS_URL), the operation is POSTed to the server and an
+    agent there executes it — the reference's CLI↔API-server model;
+    otherwise the local executor runs it in-process."""
     try:
         op = read_polyaxonfile(fpath, params=_params_to_dict(params))
     except PolyaxonfileError as e:
         raise click.ClickException(str(e))
     if name:
         op = op.model_copy(update={"name": name})
+
+    from .. import settings
+
+    remote_url = settings.get("streams_url")
+    if remote_url and op.schedule is None and op.matrix is None:
+        from ..client import ClientError, RunClient
+
+        client = RunClient(base_url=str(remote_url), project=project)
+        try:
+            uuid = client.create(op)
+            click.echo(f"run {uuid[:8]} created on {remote_url}")
+            if watch:
+                status = client.wait(uuid, timeout=86400)
+                click.echo(f"run {uuid[:8]} finished: {status}")
+                click.echo(client.logs(uuid))
+                if status == V1Statuses.FAILED:
+                    sys.exit(1)
+        except ClientError as e:
+            raise click.ClickException(str(e))
+        return
     store = RunStore()
     if op.schedule is not None:
         from ..scheduler import ScheduleRegistry
@@ -115,14 +139,23 @@ def check(fpath):
 
 @cli.group()
 def ops():
-    """Inspect and manage runs."""
+    """Inspect and manage runs (remote when streams_url is configured)."""
+
+
+def _run_client():
+    """Local RunClient, or HTTP when a remote control plane is configured
+    (POLYAXON_STREAMS_URL / `polyaxon config set streams_url ...`)."""
+    from .. import settings
+    from ..client import RunClient
+
+    url = settings.get("streams_url")
+    return RunClient(base_url=str(url)) if url else RunClient()
 
 
 @ops.command("ls")
 @click.option("--project", default=None)
 def ops_ls(project):
-    store = RunStore()
-    rows = store.list_runs(project)
+    rows = _run_client().list(project)
     if not rows:
         click.echo("no runs")
         return
@@ -149,6 +182,11 @@ def ops_get(uid):
 @click.option("-uid", "--uid", required=True)
 @click.option("--follow/--no-follow", default=False)
 def ops_logs(uid, follow):
+    from .. import settings
+
+    if settings.get("streams_url") and not follow:
+        click.echo(_run_client().logs(uid), nl=False)
+        return
     store = RunStore()
     uid = store.resolve(uid)
     if follow:
@@ -161,18 +199,14 @@ def ops_logs(uid, follow):
 @ops.command("statuses")
 @click.option("-uid", "--uid", required=True)
 def ops_statuses(uid):
-    store = RunStore()
-    uid = store.resolve(uid)
-    for c in store.get_status(uid).get("conditions", []):
+    for c in _run_client().statuses(uid):
         click.echo(f"{c.get('ts', 0):.3f}  {c['type']:<12} {c.get('reason', '')}")
 
 
 @ops.command("metrics")
 @click.option("-uid", "--uid", required=True)
 def ops_metrics(uid):
-    store = RunStore()
-    uid = store.resolve(uid)
-    for m in store.read_metrics(uid):
+    for m in _run_client().metrics(uid):
         click.echo(json.dumps(m))
 
 

@@ -289,3 +289,46 @@ def test_delete_run_local_and_http(tmp_home, tmp_path):
             remote.delete(done)  # already gone
     client.delete(queued)
     assert client.list() == []
+
+
+def test_cli_run_against_remote_control_plane(tmp_home, tmp_path, monkeypatch):
+    """POLYAXON_STREAMS_URL routes `polyaxon run` through the HTTP control
+    plane: server enqueues, agent executes, CLI watches over the wire."""
+    import threading
+
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+    from polyaxon_tpu.scheduler import Agent
+
+    store = RunStore()
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(FAST_OP))
+    with BackgroundServer(store) as srv:
+        monkeypatch.setenv("POLYAXON_STREAMS_URL", f"http://127.0.0.1:{srv.port}")
+        t = threading.Thread(
+            target=lambda: Agent(store=store).serve(
+                poll_interval=0.1,
+                stop_when=lambda: bool(
+                    store.list_runs()
+                    and store.list_runs()[0]["status"]
+                    in ("succeeded", "failed")
+                ),
+            )
+        )
+        t.start()
+        res = CliRunner().invoke(cli, ["run", "-f", str(p), "--watch"])
+        t.join(timeout=30)
+        assert res.exit_code == 0, res.output
+        assert "created on http://127.0.0.1" in res.output
+        assert "finished: succeeded" in res.output
+        assert "out-line" in res.output
+
+        # ops verbs ride the same remote control plane
+        uid = res.output.split()[1]
+        res = CliRunner().invoke(cli, ["ops", "ls"])
+        assert uid in res.output and "succeeded" in res.output
+        res = CliRunner().invoke(cli, ["ops", "metrics", "-uid", uid])
+        assert res.exit_code == 0
+        res = CliRunner().invoke(cli, ["ops", "statuses", "-uid", uid])
+        assert "succeeded" in res.output
