@@ -68,7 +68,15 @@ def run(fpath, params, name, project, watch):
     from .. import settings
 
     remote_url = settings.get("streams_url")
-    if remote_url and op.schedule is None and op.matrix is None:
+    if remote_url:
+        if op.schedule is not None or op.matrix is not None:
+            # registering these locally would silently target the WRONG
+            # store (the remote agent drains the server's store)
+            raise click.ClickException(
+                "schedules and sweeps can't be submitted to a remote control "
+                "plane from the CLI yet; run them on the server host, or "
+                "unset streams_url to execute locally"
+            )
         from ..client import ClientError, RunClient
 
         client = RunClient(base_url=str(remote_url), project=project)
@@ -82,6 +90,8 @@ def run(fpath, params, name, project, watch):
                 if status == V1Statuses.FAILED:
                     sys.exit(1)
         except ClientError as e:
+            raise click.ClickException(str(e))
+        except TimeoutError as e:
             raise click.ClickException(str(e))
         return
     store = RunStore()
@@ -168,13 +178,13 @@ def ops_ls(project):
 @ops.command("get")
 @click.option("-uid", "--uid", required=True)
 def ops_get(uid):
-    store = RunStore()
-    uid = store.resolve(uid)
+    client = _run_client()
     out = {
-        "status": store.get_status(uid),
-        "spec": store.read_spec(uid),
-        "metrics_tail": store.read_metrics(uid)[-5:],
+        "status": client.get(uid),
+        "metrics_tail": client.metrics(uid)[-5:],
     }
+    if client._http is None:  # spec only stored locally
+        out["spec"] = client.store.read_spec(client.store.resolve(uid))
     click.echo(json.dumps(out, indent=1, default=str))
 
 
@@ -184,9 +194,24 @@ def ops_get(uid):
 def ops_logs(uid, follow):
     from .. import settings
 
-    if settings.get("streams_url") and not follow:
-        click.echo(_run_client().logs(uid), nl=False)
-        return
+    if settings.get("streams_url"):
+        client = _run_client()
+        if not follow:
+            click.echo(client.logs(uid), nl=False)
+            return
+        import time as _time
+
+        from ..schemas.lifecycle import DONE_STATUSES
+
+        offset = 0
+        while True:  # poll the offset endpoint — the remote tail loop
+            chunk = client.logs(uid, offset=offset)
+            if chunk:
+                click.echo(chunk, nl=False)
+                offset += len(chunk)
+            if client.get(uid).get("status") in DONE_STATUSES:
+                return
+            _time.sleep(1.0)
     store = RunStore()
     uid = store.resolve(uid)
     if follow:
@@ -215,35 +240,38 @@ def ops_metrics(uid):
 @click.option("--path", default=None, help="artifact path to download (omit to list)")
 @click.option("-o", "--output", default=".", help="download destination dir")
 def ops_artifacts(uid, path, output):
-    """List a run's output artifacts, or download one with --path."""
-    import shutil
+    """List a run's output artifacts, or download one with --path
+    (remote when streams_url is configured)."""
     from pathlib import Path as _Path
 
-    store = RunStore()
-    uid = store.resolve(uid)
-    root = store.outputs_dir(uid)
-    if path is None:
-        files = [str(p.relative_to(root)) for p in sorted(root.rglob("*")) if p.is_file()]
-        if not files:
-            click.echo("no artifacts")
-        for f in files:
-            click.echo(f)
-        return
-    src = (root / path).resolve()
-    if not (src == root.resolve() or root.resolve() in src.parents) or not src.is_file():
-        raise click.ClickException(f"no artifact {path!r} in run {uid[:8]}")
-    dst = _Path(output) / _Path(path).name
-    dst.parent.mkdir(parents=True, exist_ok=True)
-    shutil.copy2(src, dst)
+    from ..client import ClientError
+
+    client = _run_client()
+    try:
+        if path is None:
+            files = client.artifacts(uid)
+            if not files:
+                click.echo("no artifacts")
+            for f in files:
+                click.echo(f)
+            return
+        dst = client.download_artifact(uid, path, _Path(output) / _Path(path).name)
+    except (ClientError, KeyError) as e:
+        raise click.ClickException(str(e).strip("'\""))
     click.echo(str(dst))
 
 
 @ops.command("stop")
 @click.option("-uid", "--uid", required=True)
 def ops_stop(uid):
-    store = RunStore()
-    uid = store.resolve(uid)
-    status = store.request_stop(uid)
+    from ..client import ClientError
+
+    client = _run_client()
+    try:
+        client.stop(uid)
+        status = client.get(uid).get("status", "stopping")
+    except (ClientError, KeyError) as e:
+        raise click.ClickException(str(e).strip("'\""))
     click.echo(f"{uid[:8]} {status}")
 
 
@@ -252,17 +280,16 @@ def ops_stop(uid):
 @click.option("--yes", is_flag=True, help="skip confirmation")
 def ops_delete(uid, yes):
     """Delete a finished run's data (metrics, logs, outputs) permanently."""
-    store = RunStore()
-    try:
-        uid = store.resolve(uid)
-    except KeyError as e:
-        raise click.ClickException(str(e).strip("'\""))
+    from ..client import ClientError
+
     if not yes:
         click.confirm(f"permanently delete run {uid[:8]}?", abort=True)
     try:
-        store.delete_run(uid)
-    except ValueError as e:
+        _run_client().delete(uid)
+    except (ClientError, ValueError) as e:
         raise click.ClickException(str(e))
+    except KeyError as e:
+        raise click.ClickException(str(e).strip("'\""))
     click.echo(f"{uid[:8]} deleted")
 
 

@@ -279,6 +279,35 @@ class RunClient:
         root = self.store.outputs_dir(uuid)
         return [str(p.relative_to(root)) for p in sorted(root.rglob("*")) if p.is_file()]
 
+    def download_artifact(self, uuid: str, path: str, dest) -> str:
+        """Fetch one output artifact to `dest` (a local file path)."""
+        from pathlib import Path
+
+        uuid = self._resolve(uuid)
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        if self._http:
+            url = f"{self._http.base_url}/runs/{uuid}/artifacts/{path}"
+            try:
+                with urllib.request.urlopen(url) as r:
+                    dest.write_bytes(r.read())
+            except urllib.error.HTTPError as e:
+                raise ClientError(f"GET {path}: HTTP {e.code}") from e
+            except urllib.error.URLError as e:
+                raise ClientError(f"GET {path}: {e.reason}") from e
+            return str(dest)
+        import shutil
+
+        root = self.store.outputs_dir(uuid)
+        src = (root / path).resolve()
+        root_resolved = root.resolve()
+        if (
+            src != root_resolved and root_resolved not in src.parents
+        ) or not src.is_file():
+            raise ClientError(f"no artifact {path!r} in run {uuid[:8]}")
+        shutil.copy2(src, dest)
+        return str(dest)
+
     def wait(self, uuid: str, timeout: float = 3600, poll: float = 0.5) -> str:
         """Block until the run reaches a terminal status."""
         import time

@@ -149,8 +149,13 @@ class Trainer:
         if cfg is not None:
             model_deg = mesh.shape.get("model", 1)
             check(model_deg, getattr(cfg, "n_heads", model_deg), "n_heads")
+            # GQA: k/v activations carry n_kv_heads — they shard too
+            check(model_deg, getattr(cfg, "n_kv_heads", model_deg), "n_kv_heads")
             ctx = mesh.shape.get("context", 1)
-            check(ctx, getattr(cfg, "seq_len", ctx), "seq_len")
+            # runtime shapes come from the DATA stream's seq_len, not the
+            # model's maximum — validate what will actually be sharded
+            seq = self.data.meta.get("seq_len") or getattr(cfg, "seq_len", ctx)
+            check(ctx, int(seq), "data seq_len")
             pipe = mesh.shape.get("pipeline", 1)
             check(pipe, getattr(cfg, "n_layers", pipe), "n_layers")
             exp = mesh.shape.get("expert", 1)
@@ -166,7 +171,7 @@ class Trainer:
     # -------------------------------------------------------------- setup
     def _build_step(self):
         bundle, mesh, tspec = self.bundle, self.mesh, self.tspec
-        self._validate_mesh_fit()
+        self._validate_mesh_fit()  # after self.data exists (seq_len check)
         global_batch = self.data.batch_size * jax.process_count()
         if global_batch % local_batch_slice(mesh) != 0:
             raise ValueError(

@@ -332,3 +332,19 @@ def test_cli_run_against_remote_control_plane(tmp_home, tmp_path, monkeypatch):
         assert res.exit_code == 0
         res = CliRunner().invoke(cli, ["ops", "statuses", "-uid", uid])
         assert "succeeded" in res.output
+        res = CliRunner().invoke(cli, ["ops", "stop", "-uid", uid])  # no-op on done
+        assert res.exit_code == 0
+        res = CliRunner().invoke(cli, ["ops", "logs", "-uid", uid])
+        assert "out-line" in res.output
+        res = CliRunner().invoke(cli, ["ops", "delete", "-uid", uid, "--yes"])
+        assert res.exit_code == 0, res.output
+        res = CliRunner().invoke(cli, ["ops", "ls"])
+        assert uid not in res.output
+
+        # schedules/sweeps are refused (they'd target the wrong store)
+        sweep = dict(FAST_OP)
+        sweep["matrix"] = {"kind": "mapping", "values": [{"x": 1}]}
+        p2 = tmp_path / "sweep.yaml"
+        p2.write_text(yaml.safe_dump(sweep))
+        res = CliRunner().invoke(cli, ["run", "-f", str(p2)])
+        assert res.exit_code != 0 and "remote control plane" in res.output
