@@ -354,19 +354,25 @@ def agent():
 
 @agent.command("start")
 @click.option("--poll-interval", default=1.0, type=float)
-def agent_start(poll_interval):
+@click.option("--queue", "queues", multiple=True,
+              help="only drain these queues (repeatable); default: all")
+def agent_start(poll_interval, queues):
     from ..scheduler import Agent
 
-    click.echo("agent started; polling queue (ctrl-c to stop)")
-    Agent(store=RunStore()).serve(poll_interval=poll_interval)
+    which = ", ".join(queues) if queues else "all queues"
+    click.echo(f"agent started; polling {which} (ctrl-c to stop)")
+    Agent(store=RunStore(), queues=list(queues) or None).serve(
+        poll_interval=poll_interval
+    )
 
 
 @agent.command("drain")
-def agent_drain():
+@click.option("--queue", "queues", multiple=True)
+def agent_drain(queues):
     """Process everything queued, then exit."""
     from ..scheduler import Agent
 
-    n = Agent(store=RunStore()).drain()
+    n = Agent(store=RunStore(), queues=list(queues) or None).drain()
     click.echo(f"processed {n} run(s)")
 
 

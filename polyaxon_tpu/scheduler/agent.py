@@ -182,15 +182,27 @@ class Agent:
         return count
 
     def serve(self, poll_interval: float = 1.0, stop_when=lambda: False):
-        """Long-running loop: fire due schedules, poll the queue, repeat."""
+        """Long-running loop: fire due schedules, reconcile cluster state
+        (when this agent submits to a cluster), poll the queues, repeat."""
         from .schedules import ScheduleRegistry
 
         registry = ScheduleRegistry(self.store)
+        reconciler = None
+        cluster = getattr(self.submit_fn, "cluster", None)
+        if cluster is not None:
+            from .reconciler import Reconciler
+
+            reconciler = Reconciler(self.store, cluster)
         while not stop_when():
             try:
                 registry.tick(self)
             except Exception as e:  # noqa: BLE001 — a bad schedule never kills the agent
                 print(f"schedule tick error: {e}")
+            if reconciler is not None:
+                try:
+                    reconciler.tick()
+                except Exception as e:  # noqa: BLE001 — ditto for reconcile
+                    print(f"reconcile tick error: {e}")
             # full drain per tick: an uncapped pass lets per-queue
             # concurrency batches form (a max_runs=1 budget would clamp
             # every batch to size 1 and silently disable the feature)

@@ -171,3 +171,37 @@ def test_stop_propagates_to_cluster(tmp_home, tmp_path):
     assert cluster.deleted == [uuid]
     assert store.get_status(uuid)["status"] == V1Statuses.STOPPED
     assert rec.tick() == []  # idempotent once settled
+
+
+def test_agent_serve_reconciles_cluster_runs(tmp_home, tmp_path):
+    """A serving agent with a ClusterSubmitter reconciles pod status in its
+    own loop — submit, pods succeed, run reaches SUCCEEDED, loop exits."""
+    import threading
+
+    store, cluster = RunStore(), FakeCluster()
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(SPEC))
+    op = read_polyaxonfile(str(p))
+    agent = Agent(
+        store=store,
+        submit_fn=ClusterSubmitter(store, cluster, ConnectionCatalog()),
+    )
+    uuid = agent.submit(op)
+
+    def _done():
+        return store.get_status(uuid).get("status") in ("succeeded", "failed")
+
+    t = threading.Thread(
+        target=lambda: agent.serve(poll_interval=0.05, stop_when=_done)
+    )
+    t.start()
+    # let the agent submit, then simulate the cluster finishing the gang
+    deadline = __import__("time").time() + 20
+    while uuid not in cluster.pods and __import__("time").time() < deadline:
+        __import__("time").sleep(0.05)
+    cluster.set_all(uuid, "Running")
+    __import__("time").sleep(0.2)
+    cluster.set_all(uuid, "Succeeded")
+    t.join(timeout=20)
+    assert not t.is_alive()
+    assert store.get_status(uuid)["status"] == V1Statuses.SUCCEEDED
