@@ -207,6 +207,15 @@ def run_bench() -> dict:
     if flops_per_step and peak:
         mfu = round(flops_per_step * (steps / dt) / peak, 4)
 
+    # Free the trainer's device state (params + adam moments, ~6GB at
+    # dim 2048) before the bare loop materializes its own full copy —
+    # both resident at once exhausts a v5e chip's HBM.
+    del trainer
+    import gc
+
+    gc.collect()
+    _phase("trainer state freed")
+
     bare_tps = _bare_tokens_per_sec(model_cfg, batch, seq, steps)
     _phase(f"bare-JAX baseline done: {bare_tps:,.0f} tok/s")
 

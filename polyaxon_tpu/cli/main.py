@@ -147,7 +147,26 @@ def check(fpath):
     click.echo(json.dumps(compiled.to_dict(), indent=1, default=str))
 
 
-@cli.group()
+class _RunRefGroup(click.Group):
+    """Unknown run refs surface as clean CLI errors, not the store's raw
+    traceback — every ops subcommand resolves a uid. Only the dedicated
+    UnknownRunError is caught: an unrelated KeyError is a real bug and
+    must keep its traceback."""
+
+    def invoke(self, ctx):
+        from ..client import ClientError
+        from ..store.local import UnknownRunError
+
+        try:
+            return super().invoke(ctx)
+        except UnknownRunError as e:
+            # str(KeyError) is repr(msg) — args[0] is the clean message
+            raise click.ClickException(str(e.args[0]) if e.args else str(e))
+        except ClientError as e:  # remote control plane: 404s etc.
+            raise click.ClickException(str(e))
+
+
+@cli.group(cls=_RunRefGroup)
 def ops():
     """Inspect and manage runs (remote when streams_url is configured)."""
 
@@ -256,8 +275,8 @@ def ops_artifacts(uid, path, output):
                 click.echo(f)
             return
         dst = client.download_artifact(uid, path, _Path(output) / _Path(path).name)
-    except (ClientError, KeyError) as e:
-        raise click.ClickException(str(e).strip("'\""))
+    except ClientError as e:
+        raise click.ClickException(str(e))
     click.echo(str(dst))
 
 
@@ -270,8 +289,8 @@ def ops_stop(uid):
     try:
         client.stop(uid)
         status = client.get(uid).get("status", "stopping")
-    except (ClientError, KeyError) as e:
-        raise click.ClickException(str(e).strip("'\""))
+    except ClientError as e:
+        raise click.ClickException(str(e))
     click.echo(f"{uid[:8]} {status}")
 
 
@@ -288,8 +307,6 @@ def ops_delete(uid, yes):
         _run_client().delete(uid)
     except (ClientError, ValueError) as e:
         raise click.ClickException(str(e))
-    except KeyError as e:
-        raise click.ClickException(str(e).strip("'\""))
     click.echo(f"{uid[:8]} deleted")
 
 
@@ -302,8 +319,6 @@ def _clone_cmd(uid, kind, eager):
         new_uuid = getattr(client, kind)(uid, queue=not eager)
     except (ClientError, CompilationError) as e:
         raise click.ClickException(str(e))
-    except KeyError as e:  # unknown/ambiguous uid from store.resolve
-        raise click.ClickException(str(e).strip("'\""))
     status = client.get(new_uuid).get("status", "queued")
     click.echo(f"{kind} of {uid[:8]} -> run {new_uuid[:8]} ({status})")
 

@@ -34,6 +34,7 @@ class Agent:
         devices: Optional[list] = None,
         catalog=None,
         queues: Optional[list[str]] = None,
+        cluster=None,
     ):
         from .queue import QueueRegistry
 
@@ -46,6 +47,13 @@ class Agent:
         self.queue_filter = queues
         self.executor = Executor(store=self.store, devices=devices, catalog=catalog)
         self.submit_fn = submit_fn
+        # explicit `cluster` turns on serve-loop reconciliation; falling back
+        # to submit_fn.cluster keeps the common ClusterSubmitter case working
+        # unwrapped — but a wrapped/partial submit_fn loses that attribute,
+        # so callers who decorate submit_fn must pass cluster= themselves
+        self.cluster = cluster if cluster is not None else getattr(
+            submit_fn, "cluster", None
+        )
 
     def submit(
         self,
@@ -69,6 +77,8 @@ class Agent:
         )
         from ..compiler.resolver import spec_fingerprint
 
+        routed_queue = self.queue_for(op)
+
         self.store.create_run(
             compiled.run_uuid,
             compiled.name,
@@ -76,14 +86,21 @@ class Agent:
             compiled.to_dict(),
             tags=compiled.operation.tags,
             # recorded at creation: the executor's later create_run is a
-            # no-op for existing runs, and the cache matches on this meta
-            meta={"fingerprint": spec_fingerprint(compiled), **(meta or {})},
+            # no-op for existing runs, and the cache matches on this meta.
+            # `queue` is the ROUTED queue (a pinned agent routes every op to
+            # its own queue regardless of op.queue) — reconciler ownership
+            # scoping keys on it.
+            meta={
+                "fingerprint": spec_fingerprint(compiled),
+                "queue": routed_queue.name,
+                **(meta or {}),
+            },
         )
         if prepare_fn is not None:
             prepare_fn(compiled)
         self.store.set_status(compiled.run_uuid, V1Statuses.COMPILED)
         self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
-        self.queue_for(op).push(
+        routed_queue.push(
             compiled.run_uuid,
             {"operation": compiled.operation.to_dict(), "project": compiled.project},
             priority=priority,
@@ -188,11 +205,19 @@ class Agent:
 
         registry = ScheduleRegistry(self.store)
         reconciler = None
-        cluster = getattr(self.submit_fn, "cluster", None)
-        if cluster is not None:
+        if self.cluster is not None:
             from .reconciler import Reconciler
 
-            reconciler = Reconciler(self.store, cluster)
+            # Ownership scoping: two agents on a shared store must never
+            # both drive the same run's gang restarts (non-atomic attempt
+            # bump + double delete/submit). A queue-filtered agent owns its
+            # queues; a pinned agent owns its one queue; an UNFILTERED agent
+            # owns everything — deploy multiple agents only with disjoint
+            # --queue filters.
+            scope = self.queue_filter
+            if scope is None and self._pinned:
+                scope = [self.queue.name]
+            reconciler = Reconciler(self.store, self.cluster, queues=scope)
         while not stop_when():
             try:
                 registry.tick(self)

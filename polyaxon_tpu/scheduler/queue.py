@@ -20,6 +20,7 @@ from ..store.local import RunStore
 class RunQueue:
     def __init__(self, store: Optional[RunStore] = None, name: str = "default"):
         self.store = store or RunStore()
+        self.name = name
         self.path = Path(self.store.home) / "queues" / f"{name}.jsonl"
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.path.touch(exist_ok=True)

@@ -100,9 +100,34 @@ def is_preemption(pods: list[dict]) -> bool:
 
 
 class Reconciler:
-    def __init__(self, store: RunStore, cluster: ClusterClient):
+    def __init__(
+        self,
+        store: RunStore,
+        cluster: ClusterClient,
+        queues: Optional[list[str]] = None,
+    ):
+        """`queues` scopes ownership: when set, only runs routed through one
+        of the named queues are reconciled. Two agents sharing a store (each
+        serving its own queues) must not double-drive the same gang — a
+        non-atomic read-bump of cluster_attempts plus double delete/submit
+        would burn the retry budget or tear down a fresh resubmit."""
         self.store = store
         self.cluster = cluster
+        self.queues = set(queues) if queues is not None else None
+
+    def _owns(self, uuid: str, status: dict) -> bool:
+        """Ownership key: the ROUTED queue recorded in run meta at submit
+        time (free — `status` is already fetched). A legacy run without the
+        meta key is owned by every reconciler: the spec's DECLARED queue is
+        not the routed queue under a pinned agent, so guessing from it could
+        orphan an active run — shared reconciliation (the pre-scoping
+        behavior) is the safe degradation."""
+        if self.queues is None:
+            return True
+        routed = (status.get("meta") or {}).get("queue")
+        if routed is None:
+            return True
+        return routed in self.queues
 
     # ------------------------------------------------------------ helpers
     def _max_retries(self, run_uuid: str) -> int:
@@ -142,16 +167,20 @@ class Reconciler:
             manifest_path = self.store.run_dir(uuid) / "manifests.json"
             if not manifest_path.exists():
                 continue  # not a cluster run
-            current = V1Statuses(self.store.get_status(uuid)["status"])
-            if current in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+            status = self.store.get_status(uuid)
+            current = V1Statuses(status["status"])
+            stopping = current in (V1Statuses.STOPPING, V1Statuses.STOPPED)
+            if not stopping and current not in _ACTIVE:
+                continue  # terminal: skip before any ownership/spec reads
+            if not self._owns(uuid, status):
+                continue  # another agent's queue drives this gang
+            if stopping:
                 # stop propagation: tear down the gang, then settle the status
                 if self.cluster.status(uuid).get("pods"):
                     self.cluster.delete(uuid)
                 if current == V1Statuses.STOPPING:
                     self.store.set_status(uuid, V1Statuses.STOPPED, reason="reconciler")
                     changes.append((uuid, V1Statuses.STOPPED))
-                continue
-            if current not in _ACTIVE:
                 continue
             pods = self.cluster.status(uuid).get("pods", [])
             agg = aggregate_pods(pods)

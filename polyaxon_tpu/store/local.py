@@ -26,6 +26,12 @@ from typing import Any, Iterator, Optional
 from ..schemas.lifecycle import V1Statuses, can_transition, is_done
 
 
+class UnknownRunError(KeyError):
+    """A run reference (uuid / prefix / name) matched nothing in the store.
+    KeyError subclass: existing `except KeyError` callers keep working;
+    the CLI catches THIS type so unrelated KeyErrors still traceback."""
+
+
 def polyaxon_home() -> Path:
     """Env wins, then the user config file, then the default (settings.py)."""
     env = os.environ.get("POLYAXON_HOME")
@@ -240,7 +246,7 @@ class RunStore:
         by_name = [r for r in runs if r.get("name") == ref]
         if by_name:
             return by_name[-1]["uuid"]
-        raise KeyError(f"no run matching {ref!r}")
+        raise UnknownRunError(f"no run matching {ref!r}")
 
     def watch_logs(self, run_uuid: str, poll: float = 0.3) -> Iterator[str]:
         """Tail logs until the run reaches a terminal status."""

@@ -188,11 +188,21 @@ def test_agent_serve_reconciles_cluster_runs(tmp_home, tmp_path):
     )
     uuid = agent.submit(op)
 
-    def _done():
-        return store.get_status(uuid).get("status") in ("succeeded", "failed")
+    import time as _time
 
+    hard_stop = _time.time() + 45  # serve() must exit even if the run wedges
+
+    def _done():
+        return (
+            store.get_status(uuid).get("status") in ("succeeded", "failed")
+            or _time.time() > hard_stop
+        )
+
+    # daemon: an assertion failure below must not leave a live non-daemon
+    # thread keeping the interpreter (and CI) alive forever
     t = threading.Thread(
-        target=lambda: agent.serve(poll_interval=0.05, stop_when=_done)
+        target=lambda: agent.serve(poll_interval=0.05, stop_when=_done),
+        daemon=True,
     )
     t.start()
     # let the agent submit, then simulate the cluster finishing the gang
@@ -205,3 +215,31 @@ def test_agent_serve_reconciles_cluster_runs(tmp_home, tmp_path):
     t.join(timeout=20)
     assert not t.is_alive()
     assert store.get_status(uuid)["status"] == V1Statuses.SUCCEEDED
+
+
+def test_reconciler_queue_scoping(tmp_home, tmp_path):
+    """Two queue-filtered agents share one store: each reconciler only
+    drives runs routed through its own queues — the other agent's runs are
+    invisible to it (no double delete/submit, no double attempt-bump)."""
+    store, cluster = RunStore(), FakeCluster()
+    submit = ClusterSubmitter(store, cluster, ConnectionCatalog())
+    agent = Agent(store=store, submit_fn=submit)
+    uuids = {}
+    for qname in ("a", "b"):
+        spec = dict(SPEC, queue=qname, name=f"job-{qname}")
+        p = tmp_path / f"op-{qname}.yaml"
+        p.write_text(yaml.safe_dump(spec))
+        uuids[qname] = agent.submit(read_polyaxonfile(str(p)))
+    agent.drain()
+
+    rec_a = Reconciler(store, cluster, queues=["a"])
+    cluster.set_all(uuids["a"], "Succeeded")
+    cluster.set_all(uuids["b"], "Succeeded")
+    changed = dict(rec_a.tick())
+    assert uuids["a"] in changed
+    assert uuids["b"] not in changed
+    assert store.get_status(uuids["a"])["status"] == V1Statuses.SUCCEEDED
+    # queue-b run untouched until ITS agent's reconciler ticks
+    assert store.get_status(uuids["b"])["status"] == V1Statuses.SCHEDULED
+    rec_b = Reconciler(store, cluster, queues=["b"])
+    assert dict(rec_b.tick()) == {uuids["b"]: V1Statuses.SUCCEEDED}
