@@ -1,0 +1,116 @@
+"""Attention-backend micro-bench: XLA einsum+softmax vs the Pallas flash
+kernel, fwd and fwd+bwd, across sequence lengths on the current device.
+
+Informs the transformer's default `attention:` backend (SURVEY.md §5 long-
+context obligation): the XLA path materializes the [B,H,S,S] score matrix
+(O(S^2) HBM traffic), the flash kernel streams KV blocks through VMEM
+(O(S) memory). The crossover is what this measures on real hardware.
+
+  python benchmarks/attention_bench.py            # default sweep
+  python benchmarks/attention_bench.py 1024 8192  # explicit seq lengths
+
+Prints one JSON line per (seq, backend, mode) with tokens/sec and ms/call.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _time_call(fn, *args, iters: int = 20) -> float:
+    """Median-of-3 trimmed wall time per call, compile excluded."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    # honor POLYAXON_JAX_PLATFORM=cpu BEFORE backend init — plain
+    # JAX_PLATFORMS loses to the axon TPU plugin, and a dead tunnel
+    # otherwise blocks ~25 min in native init
+    from polyaxon_tpu.utils.jax_platform import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.ops.attention import dot_product_attention
+
+    seqs = [int(a) for a in sys.argv[1:]] or [512, 1024, 2048, 4096, 8192]
+    device = jax.devices()[0]
+    batch, heads, head_dim = 4, 16, 128
+    on_tpu = device.platform == "tpu"
+    backends = ("xla", "flash")
+    if not on_tpu:
+        # CPU runs the Pallas kernel in interpret mode (minutes per call) —
+        # the backend comparison is only meaningful on the chip anyway
+        seqs = [s for s in seqs if s <= 512]
+        batch, backends = 2, ("xla",)
+
+    for seq in seqs:
+        key = jax.random.PRNGKey(0)
+        shape = (batch, seq, heads, head_dim)
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
+            for i in range(3)
+        )
+        for backend in backends:
+            try:
+                fwd = jax.jit(
+                    partial(
+                        dot_product_attention, causal=True, backend=backend
+                    )
+                )
+
+                def loss(q, k, v):
+                    return (
+                        dot_product_attention(
+                            q, k, v, causal=True, backend=backend
+                        )
+                        .astype(jnp.float32)
+                        .sum()
+                    )
+
+                bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                for mode, fn in (("fwd", fwd), ("fwd+bwd", bwd)):
+                    dt = _time_call(fn, q, k, v)
+                    print(
+                        json.dumps(
+                            {
+                                "seq": seq,
+                                "backend": backend,
+                                "mode": mode,
+                                "ms_per_call": round(dt * 1e3, 3),
+                                "tokens_per_sec": round(batch * seq / dt, 1),
+                                "device_kind": device.device_kind,
+                                "batch": batch,
+                                "heads": heads,
+                                "head_dim": head_dim,
+                            }
+                        ),
+                        flush=True,
+                    )
+            except Exception as e:  # noqa: BLE001 — report, keep sweeping
+                print(
+                    json.dumps(
+                        {"seq": seq, "backend": backend, "error": f"{type(e).__name__}: {e}"[:200]}
+                    ),
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -263,34 +263,24 @@ def ops_artifacts(uid, path, output):
     (remote when streams_url is configured)."""
     from pathlib import Path as _Path
 
-    from ..client import ClientError
-
     client = _run_client()
-    try:
-        if path is None:
-            files = client.artifacts(uid)
-            if not files:
-                click.echo("no artifacts")
-            for f in files:
-                click.echo(f)
-            return
-        dst = client.download_artifact(uid, path, _Path(output) / _Path(path).name)
-    except ClientError as e:
-        raise click.ClickException(str(e))
+    if path is None:
+        files = client.artifacts(uid)
+        if not files:
+            click.echo("no artifacts")
+        for f in files:
+            click.echo(f)
+        return
+    dst = client.download_artifact(uid, path, _Path(output) / _Path(path).name)
     click.echo(str(dst))
 
 
 @ops.command("stop")
 @click.option("-uid", "--uid", required=True)
 def ops_stop(uid):
-    from ..client import ClientError
-
     client = _run_client()
-    try:
-        client.stop(uid)
-        status = client.get(uid).get("status", "stopping")
-    except ClientError as e:
-        raise click.ClickException(str(e))
+    client.stop(uid)
+    status = client.get(uid).get("status", "stopping")
     click.echo(f"{uid[:8]} {status}")
 
 
@@ -299,13 +289,11 @@ def ops_stop(uid):
 @click.option("--yes", is_flag=True, help="skip confirmation")
 def ops_delete(uid, yes):
     """Delete a finished run's data (metrics, logs, outputs) permanently."""
-    from ..client import ClientError
-
     if not yes:
         click.confirm(f"permanently delete run {uid[:8]}?", abort=True)
     try:
         _run_client().delete(uid)
-    except (ClientError, ValueError) as e:
+    except ValueError as e:  # clone-target guard; group catches ClientError
         raise click.ClickException(str(e))
     click.echo(f"{uid[:8]} deleted")
 
@@ -317,7 +305,7 @@ def _clone_cmd(uid, kind, eager):
     client = RunClient()
     try:
         new_uuid = getattr(client, kind)(uid, queue=not eager)
-    except (ClientError, CompilationError) as e:
+    except CompilationError as e:  # group catches ClientError
         raise click.ClickException(str(e))
     status = client.get(new_uuid).get("status", "queued")
     click.echo(f"{kind} of {uid[:8]} -> run {new_uuid[:8]} ({status})")

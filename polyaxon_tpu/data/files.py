@@ -67,22 +67,59 @@ def _token_stream(corpus, seq_len, batch_size, seed, process_index, process_coun
 
 @register_dataset("token_file")
 def token_file(batch_size, config, seed, process_index, process_count=1):
-    """Causal-LM windows from a memory-mapped token corpus."""
+    """Causal-LM windows from a memory-mapped token corpus.
+
+    `loader: native|python|auto` (default auto) picks the C++ prefetch
+    loader (native/dataloader.cpp — worker threads gather windows ahead of
+    demand, next() is one memcpy) with transparent fallback to the Python
+    mmap path when the native lib can't build or the dtype is unsupported.
+    """
     seq_len = int(config.get("seq_len", 1024))
-    corpus = _load_tokens(str(config.get("path", "")), config.get("dtype"))
+    path = str(config.get("path", ""))
+    loader = str(config.get("loader", "auto"))
+    if loader not in ("native", "python", "auto"):
+        raise ValueError(
+            f"token_file loader must be native|python|auto, got {loader!r}"
+        )
+    corpus = _load_tokens(path, config.get("dtype"))
     # don't scan a multi-GB mmap when vocab_size is declared
     vocab = config.get("vocab_size") or int(corpus.max()) + 1
+    meta = {
+        "seq_len": seq_len,
+        "corpus_tokens": int(len(corpus)),
+        "vocab_size": int(vocab),
+    }
+
+    iterator = None
+    if loader in ("native", "auto"):
+        try:
+            from ..native.dataloader import NativeTokenLoader
+
+            iterator = NativeTokenLoader(
+                path,
+                seq_len=seq_len,
+                batch_size=batch_size,
+                dtype=str(config.get("dtype") or "uint16"),
+                seed=int(seed),
+                process_index=process_index,
+                process_count=process_count,
+                n_threads=int(config.get("loader_threads", 1)),
+            )
+            meta["loader"] = "native"
+        except Exception as e:  # noqa: BLE001 — fall back, unless forced
+            if loader == "native":
+                raise
+            meta["loader"] = f"python (native unavailable: {type(e).__name__})"
+    if iterator is None:
+        meta.setdefault("loader", "python")
+        iterator = _token_stream(
+            corpus, seq_len, batch_size, seed, process_index, process_count
+        )
     return DataSpec(
         name="token_file",
-        iterator=_token_stream(
-            corpus, seq_len, batch_size, seed, process_index, process_count
-        ),
+        iterator=iterator,
         batch_size=batch_size,
-        meta={
-            "seq_len": seq_len,
-            "corpus_tokens": int(len(corpus)),
-            "vocab_size": int(vocab),
-        },
+        meta=meta,
     )
 
 
