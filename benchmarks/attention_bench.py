@@ -24,7 +24,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def _time_call(fn, *args, iters: int = 20) -> float:
-    """Median-of-3 trimmed wall time per call, compile excluded."""
+    """Mean wall time per call over `iters` calls, compile excluded (one
+    warmup call runs first)."""
     import jax
 
     out = fn(*args)

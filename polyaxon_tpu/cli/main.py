@@ -299,7 +299,7 @@ def ops_delete(uid, yes):
 
 
 def _clone_cmd(uid, kind, eager):
-    from ..client import ClientError, RunClient
+    from ..client import RunClient
     from ..compiler.resolver import CompilationError
 
     client = RunClient()

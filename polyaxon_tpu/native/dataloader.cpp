@@ -70,14 +70,6 @@ struct Loader {
   std::condition_variable cv_free;
   std::atomic<bool> stop{false};
 
-  int64_t token_at(int64_t i) const {
-    switch (dtype) {
-      case U16: return reinterpret_cast<const uint16_t*>(data)[i];
-      case U32: return reinterpret_cast<const uint32_t*>(data)[i];
-      default:  return reinterpret_cast<const int32_t*>(data)[i];
-    }
-  }
-
   void fill(int32_t* out, std::mt19937_64& rng) const {
     std::uniform_int_distribution<int64_t> dist(0, n_mine - 1);
     for (int64_t b = 0; b < batch; ++b) {

@@ -21,7 +21,9 @@ _manager_lock = threading.Lock()
 _managers: dict[str, object] = {}
 
 
-def _manager(directory: str):
+def _manager(directory: str, keep: Optional[int] = None):
+    """One manager per directory; retention (`keep`) is fixed at first use
+    for that directory — a run has a single policy for its lifetime."""
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
@@ -31,34 +33,41 @@ def _manager(directory: str):
             mgr = ocp.CheckpointManager(
                 directory,
                 options=ocp.CheckpointManagerOptions(
-                    max_to_keep=3, enable_async_checkpointing=True
+                    max_to_keep=keep or 3, enable_async_checkpointing=True
                 ),
             )
             _managers[directory] = mgr
         return mgr
 
 
-def save_checkpoint(directory: str, step: int, state, *, wait: bool = False):
+def save_checkpoint(
+    directory: str, step: int, state, *, wait: bool = False,
+    keep: Optional[int] = None,
+):
     import orbax.checkpoint as ocp
 
-    mgr = _manager(directory)
+    mgr = _manager(directory, keep=keep)
     mgr.save(step, args=ocp.args.StandardSave(state))
     if wait:
         mgr.wait_until_finished()
 
 
-def latest_step(directory: str) -> Optional[int]:
+def latest_step(directory: str, keep: Optional[int] = None) -> Optional[int]:
+    """`keep` must match the run's retention policy: resume paths touch the
+    manager FIRST, and the per-directory cache pins whatever options the
+    first call used — a keep-less restore would lock the default in and
+    silently override the spec's checkpointKeep for every later save."""
     if not directory or not os.path.isdir(directory):
         return None
-    return _manager(directory).latest_step()
+    return _manager(directory, keep=keep).latest_step()
 
 
-def restore_checkpoint(directory: str, step: int, target):
+def restore_checkpoint(directory: str, step: int, target, keep: Optional[int] = None):
     """Restore into the sharding/structure of `target` (the freshly built
     state) so arrays land directly on their mesh devices."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(directory)
+    mgr = _manager(directory, keep=keep)
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
         if isinstance(x, jax.Array)

@@ -547,18 +547,34 @@ class Trainer:
         self.log_fn(step, vals)
 
     # -------------------------------------------------------------- ckpt
+    def _ckpt_keep(self) -> Optional[int]:
+        return (
+            int(self.tspec.checkpoint_keep)
+            if self.tspec.checkpoint_keep
+            else None
+        )
+
     def save(self, step: int, wait: bool = False):
         from .checkpoint import save_checkpoint
 
-        save_checkpoint(self.checkpoint_dir, step, self.state, wait=wait)
+        save_checkpoint(
+            self.checkpoint_dir, step, self.state, wait=wait,
+            keep=self._ckpt_keep(),
+        )
 
     def restore(self) -> int:
+        # keep flows through restore too: the per-directory manager cache
+        # pins its options at FIRST touch, and resume touches it before the
+        # first save — a keep-less call here would lock in the default
         from .checkpoint import latest_step, restore_checkpoint
 
-        step = latest_step(self.checkpoint_dir)
+        keep = self._ckpt_keep()
+        step = latest_step(self.checkpoint_dir, keep=keep)
         if step is None:
             return 0
-        self.state = restore_checkpoint(self.checkpoint_dir, step, self.state)
+        self.state = restore_checkpoint(
+            self.checkpoint_dir, step, self.state, keep=keep
+        )
         return step
 
 

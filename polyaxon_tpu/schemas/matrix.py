@@ -237,6 +237,34 @@ class V1Hyperband(V1MatrixBase):
     seed: Optional[int] = None
 
 
+class V1Asha(V1MatrixBase):
+    """Asynchronous successive halving (Li et al. 2020). Unlike Hyperband's
+    rung barriers, promotions happen per-completion: whenever the top 1/eta
+    of a rung's finished trials contains an unpromoted config, it advances
+    at eta x the resource — stragglers never block the sweep. Budget is
+    `max_iterations` total trial executions."""
+
+    kind: Literal["asha"] = "asha"
+    params: dict[str, V1HpParam]
+    max_iterations: int  # total trial-execution budget
+    eta: int = 3
+    min_resource: int | float = 1  # rung-0 resource
+    max_resource: int | float  # promotion ceiling
+    resource: V1OptimizationResource
+    metric: V1OptimizationMetric
+    seed: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _check_resources(self):
+        if self.min_resource <= 0 or self.max_resource < self.min_resource:
+            raise ValueError(
+                "asha needs 0 < minResource <= maxResource"
+            )
+        if self.eta < 2:
+            raise ValueError("asha eta must be >= 2")
+        return self
+
+
 class V1Bayes(V1MatrixBase):
     kind: Literal["bayes"] = "bayes"
     params: dict[str, V1HpParam]
@@ -291,6 +319,7 @@ V1Matrix = Union[
     V1GridSearch,
     V1RandomSearch,
     V1Hyperband,
+    V1Asha,
     V1Bayes,
     V1Hyperopt,
     V1Iterative,
@@ -306,6 +335,7 @@ def parse_matrix(data: dict) -> V1Matrix:
         "grid": V1GridSearch,
         "random": V1RandomSearch,
         "hyperband": V1Hyperband,
+        "asha": V1Asha,
         "bayes": V1Bayes,
         "hyperopt": V1Hyperopt,
         "iterative": V1Iterative,

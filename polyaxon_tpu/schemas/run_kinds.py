@@ -84,6 +84,10 @@ class V1TrainSpec(BaseSchema):
     profile_stop: Optional[int | str] = None
     log_every: int | str = 10
     checkpoint_every: Optional[int | str] = None
+    # retention: how many recent checkpoints survive on disk (Orbax
+    # max_to_keep); long runs with frequent saves must not fill the
+    # artifact store. Default 3.
+    checkpoint_keep: Optional[int | str] = None
     resume: Optional[bool] = None
     seed: int | str = 0
     precision: Literal["bfloat16", "float32", "mixed"] = "mixed"

@@ -24,6 +24,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..schemas.matrix import (
+    V1Asha,
     V1Bayes,
     V1GridSearch,
     V1Hyperband,
@@ -196,6 +197,108 @@ class HyperbandManager(SearchManager):
             self._bracket_idx += 1
             self._rung = 0
             self._promoted = None
+
+
+class AshaManager(SearchManager):
+    """ASHA — asynchronous successive halving (Li et al. 2020, MLSys).
+
+    Hyperband's rung is a BARRIER: every config in the rung must finish
+    before any promotion. ASHA promotes per-completion: after each observe,
+    any config in the top 1/eta of its rung's finished trials that hasn't
+    been promoted advances to the next rung at eta x the resource. With
+    concurrent trials this keeps every device busy — stragglers and
+    failures never stall the sweep, which is exactly the fleet behavior
+    wanted for parallel trials on TPU sub-slices (tuner/placement.py).
+
+    Rung i resource: min_resource * eta^i, capped at max_resource (top
+    rung). Budget: `max_iterations` total trial executions across rungs.
+    """
+
+    def __init__(self, matrix: V1Asha):
+        self.matrix = matrix
+        self._rng = np.random.default_rng(matrix.seed or 0)
+        self.eta = float(matrix.eta)
+        self.r_min = float(matrix.min_resource)
+        self.r_max = float(matrix.max_resource)
+        self.n_rungs = (
+            int(math.floor(math.log(self.r_max / self.r_min) / math.log(self.eta)))
+            + 1
+        )
+        # rung i → list of (key, score); key identifies a config across rungs
+        self._rungs: list[list[tuple[int, float]]] = [
+            [] for _ in range(self.n_rungs)
+        ]
+        self._configs: dict[int, dict] = {}
+        self._promoted: set[tuple[int, int]] = set()  # (rung, key)
+        self._started = 0
+        self._next_key = 0
+
+    def _resource(self, rung: int) -> float:
+        r = min(self.r_min * self.eta**rung, self.r_max)
+        if self.matrix.resource.type == "int":
+            return float(int(round(r)))
+        return r
+
+    @property
+    def done(self) -> bool:
+        return self._started >= int(self.matrix.max_iterations)
+
+    def _promotable(self) -> Optional[tuple[int, int]]:
+        """(rung, key) of the best unpromoted top-1/eta config, scanning
+        from the highest rung down (finish strong candidates first)."""
+        for i in range(self.n_rungs - 2, -1, -1):
+            finished = sorted(self._rungs[i], key=lambda t: t[1], reverse=True)
+            k = int(len(finished) / self.eta)
+            for key, _ in finished[:k]:
+                if (i, key) not in self._promoted:
+                    return i, key
+        return None
+
+    def suggest(self) -> list[Suggestion]:
+        batch = []
+        width = max(1, int(self.matrix.concurrency or 1))
+        budget = int(self.matrix.max_iterations) - self._started
+        for _ in range(min(width, budget)):
+            promo = self._promotable()
+            if promo is not None:
+                rung, key = promo
+                self._promoted.add((rung, key))
+                sug = Suggestion(
+                    params=dict(self._configs[key]),
+                    bracket=key,  # bracket slot carries the config key
+                    rung=rung + 1,
+                    resource=self._resource(rung + 1),
+                )
+            else:
+                key = self._next_key
+                self._next_key += 1
+                self._configs[key] = sample_config(self.matrix.params, self._rng)
+                sug = Suggestion(
+                    params=dict(self._configs[key]),
+                    bracket=key,
+                    rung=0,
+                    resource=self._resource(0),
+                )
+            self._started += 1
+            batch.append(sug)
+        return batch
+
+    def observe(self, results):
+        for sug, obj in results:
+            if obj is None:
+                continue  # failed trial: never promotable, budget spent
+            self._rungs[int(sug.rung)].append((int(sug.bracket), float(obj)))
+
+    def best_rung_table(self) -> list[dict]:
+        """Introspection for tests/UI: per-rung counts and resources."""
+        return [
+            {
+                "rung": i,
+                "resource": self._resource(i),
+                "finished": len(self._rungs[i]),
+            }
+            for i in range(self.n_rungs)
+        ]
 
 
 class BayesSearchManager(SearchManager):
@@ -633,6 +736,7 @@ def build_manager(matrix: V1Matrix) -> SearchManager:
         "random": RandomSearchManager,
         "mapping": MappingManager,
         "hyperband": HyperbandManager,
+        "asha": AshaManager,
         "bayes": _build_bayes,
         "hyperopt": HyperoptManager,
         "iterative": IterativeManager,

@@ -62,6 +62,45 @@ class TestTrainer:
         r = Trainer(make_program(precision="mixed", steps=10), mesh_axes={"data": 8}).run()
         assert r.history[-1]["loss"] < r.history[0]["loss"]
 
+    def test_checkpoint_retention_keep(self, tmp_path):
+        """checkpointKeep bounds on-disk checkpoints: a frequent-save run
+        must not fill the artifact store."""
+        import re
+
+        from polyaxon_tpu.runtime.checkpoint import close_all
+
+        ckdir = tmp_path / "ck-keep"
+        p = make_program(steps=8, checkpointEvery=2, checkpointKeep=2)
+        t = Trainer(p, mesh_axes={"data": 8}, checkpoint_dir=str(ckdir))
+        t.run()
+        close_all()  # flush async saves + release the manager
+        steps = sorted(
+            int(d.name) for d in ckdir.iterdir() if re.fullmatch(r"\d+", d.name)
+        )
+        assert steps == [6, 8], steps  # only the newest `keep` survive
+
+    def test_checkpoint_keep_survives_resume(self, tmp_path):
+        """Resume touches the manager before the first save; checkpointKeep
+        must flow through restore or the cached manager pins the default
+        retention and silently overrides the spec."""
+        import re
+
+        from polyaxon_tpu.runtime.checkpoint import close_all
+
+        ckdir = tmp_path / "ck-resume-keep"
+        p = make_program(steps=4, checkpointEvery=2, checkpointKeep=4)
+        Trainer(p, mesh_axes={"data": 8}, checkpoint_dir=str(ckdir)).run()
+        close_all()
+        p2 = make_program(steps=10, checkpointEvery=2, checkpointKeep=4, resume=True)
+        t2 = Trainer(p2, mesh_axes={"data": 8}, checkpoint_dir=str(ckdir))
+        assert t2.restore() == 4  # manager first touched by resume
+        t2.run()
+        close_all()
+        steps = sorted(
+            int(d.name) for d in ckdir.iterdir() if re.fullmatch(r"\d+", d.name)
+        )
+        assert steps == [4, 6, 8, 10], steps  # keep=4 honored, not default 3
+
     def test_checkpoint_resume(self, tmp_path):
         ckdir = str(tmp_path / "ck")
         p = make_program(steps=10, checkpointEvery=5)

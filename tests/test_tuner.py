@@ -461,3 +461,136 @@ def test_baxus_optimizes_sparse_objective():
     mgr = build_manager(_bayes_matrix(8, "baxus", iters=30))
     best = _drive(mgr, sparse, rounds=31)
     assert best is not None and best > -0.05, f"baxus best {best}"
+
+
+# ---------------------------------------------------------------- ASHA
+def _asha(concurrency=1, max_iterations=20, eta=3, r_min=1, r_max=9, seed=1):
+    return build_manager(
+        parse_matrix(
+            {
+                "kind": "asha",
+                "params": PARAMS,
+                "maxIterations": max_iterations,
+                "eta": eta,
+                "minResource": r_min,
+                "maxResource": r_max,
+                "resource": {"name": "steps", "type": "int"},
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "concurrency": concurrency,
+                "seed": seed,
+            }
+        )
+    )
+
+
+def test_asha_promotes_asynchronously():
+    """No rung barrier: as soon as a config sits in the top floor(n/eta) of
+    its rung's FINISHED trials, the very next suggest() promotes it — while
+    hyperband would still be waiting for the whole rung. eta=2, rungs at
+    resource 1 -> 2 -> 4."""
+    mgr = _asha(eta=2, r_min=1, r_max=4)
+    seen = []
+    # two rung-0 trials (scores 0, -1): floor(2/2)=1 -> best is promotable
+    for score in (0.0, -1.0):
+        (sug,) = mgr.suggest()
+        assert sug.rung == 0 and sug.resource == 1.0
+        seen.append(sug)
+        mgr.observe([(sug, score)])
+    (promo,) = mgr.suggest()
+    assert promo.rung == 1 and promo.resource == 2.0
+    assert promo.params == seen[0].params  # the best config advanced
+    mgr.observe([(promo, 0.0)])
+    # rung 1 has 1 finished: floor(1/2)=0 -> nothing promotable there;
+    # rung 0's single top slot is already promoted -> grow rung 0 instead
+    (a,) = mgr.suggest()
+    assert a.rung == 0
+    mgr.observe([(a, -2.0)])  # rung 0 finished: 0,-1,-2 -> floor(3/2)=1
+    (b,) = mgr.suggest()
+    assert b.rung == 0  # top-1 still the promoted config
+    mgr.observe([(b, -3.0)])  # 4 finished -> floor(4/2)=2: -1 promotable
+    (p2,) = mgr.suggest()
+    assert p2.rung == 1 and p2.params == seen[1].params
+    mgr.observe([(p2, -1.0)])
+    # rung 1 now has 2 finished (0, -1): its best advances to the top rung
+    (top,) = mgr.suggest()
+    assert top.rung == 2 and top.resource == 4.0
+    assert top.params == seen[0].params
+
+
+def test_asha_budget_and_rung_cap():
+    """The sweep stops at maxIterations executions; resources never exceed
+    maxResource; failed trials (objective None) are never promoted."""
+    mgr = _asha(concurrency=4, max_iterations=19, eta=2, r_min=1, r_max=4)
+    total = 0
+    rng = np.random.default_rng(0)
+    while not mgr.done:
+        batch = mgr.suggest()
+        assert batch, "suggest returned empty before budget exhausted"
+        total += len(batch)
+        results = []
+        for s in batch:
+            assert s.resource <= 4.0
+            # every 4th trial "fails"
+            obj = None if total % 4 == 0 else float(rng.normal())
+            results.append((s, obj))
+        mgr.observe(results)
+    assert total == 19
+    table = mgr.best_rung_table()
+    assert [row["resource"] for row in table] == [1.0, 2.0, 4.0]
+    assert sum(row["finished"] for row in table) <= 19
+
+
+def test_asha_sweep_end_to_end(tmp_home, tmp_path):
+    """ASHA through the real sweep driver: trials execute, the best config
+    wins, and higher rungs re-run good configs at more steps."""
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.store.local import RunStore
+    from polyaxon_tpu.tuner.driver import run_sweep
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "asha-mlp",
+        "matrix": {
+            "kind": "asha",
+            "params": {
+                "lr": {"kind": "choice", "value": [0.05, 1e-6]},
+            },
+            "maxIterations": 8,
+            "eta": 2,
+            "minResource": 4,
+            "maxResource": 16,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "seed": 3,
+        },
+        "component": {
+            "kind": "component",
+            "name": "asha-mlp",
+            "inputs": [
+                {"name": "lr", "type": "float"},
+                {"name": "steps", "type": "int", "value": 4},
+            ],
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {"name": "mlp", "config": {"input_dim": 16, "num_classes": 4, "hidden": [32]}},
+                    "data": {"name": "synthetic", "batchSize": 32, "config": {"shape": [16], "num_classes": 4}},
+                    "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                    "train": {"steps": "{{ params.steps }}", "logEvery": 4, "precision": "float32"},
+                },
+            },
+        },
+    }
+    p = tmp_path / "asha.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    op = read_polyaxonfile(str(p))
+    out = run_sweep(op, store=RunStore(), log_fn=lambda *a: None)
+    assert len(out["trials"]) == 8
+    assert out["best"] is not None
+    # the healthy lr must win over the degenerate one
+    assert out["best"]["params"]["lr"] == 0.05
+    # async promotion happened: some trial ran at more than minResource
+    assert any(t["params"]["steps"] > 4 for t in out["trials"])
