@@ -167,6 +167,34 @@ class Trainer:
                         "experts (set model.config.n_experts)"
                     )
                 check(exp, n_experts, "n_experts")
+        self._validate_data_shape()
+
+    def _validate_data_shape(self):
+        """Feature-dim mismatches between the data stream and the model
+        surface as an opaque flax ScopeParamShapeError at apply time —
+        catch them up front with a config-level message. Only enforced for
+        datasets that declare their feature shape (classification streams);
+        token streams size themselves by seq_len."""
+        declared = self.data.meta.get("shape")
+        if not declared:
+            return
+        example = self.bundle.example_inputs(1)
+        if not hasattr(example, "shape") or example.ndim < 2:
+            return
+        import math as _math
+
+        model_shape = tuple(example.shape[1:])
+        declared = tuple(declared)
+        # element-count comparison, not tuple equality: models may flatten
+        # (mlp reshapes (28,28,1) -> 784), so (28,28,1) vs (784,) is valid
+        if _math.prod(declared) != _math.prod(model_shape):
+            raise ValueError(
+                f"data/model shape mismatch: dataset "
+                f"'{self.data.name}' emits features of shape "
+                f"{declared} but model '{self.program.model.name}' "
+                f"expects {model_shape} — align data.config.shape with the "
+                f"model config"
+            )
 
     # -------------------------------------------------------------- setup
     def _build_step(self):

@@ -86,7 +86,8 @@ class V1TrainSpec(BaseSchema):
     checkpoint_every: Optional[int | str] = None
     # retention: how many recent checkpoints survive on disk (Orbax
     # max_to_keep); long runs with frequent saves must not fill the
-    # artifact store. Default 3.
+    # artifact store. Default 3; must be >= 1 when set (0 would silently
+    # coerce to the default, negatives would flow into Orbax unchecked).
     checkpoint_keep: Optional[int | str] = None
     resume: Optional[bool] = None
     seed: int | str = 0
@@ -104,6 +105,17 @@ class V1TrainSpec(BaseSchema):
     # update — trades step latency for a bigger effective batch in the
     # same HBM footprint
     grad_accum: Optional[int | str] = None
+
+    @model_validator(mode="after")
+    def _check_checkpoint_keep(self):
+        # str values are {{ param }} templates resolved at compile time
+        if isinstance(self.checkpoint_keep, int) and self.checkpoint_keep < 1:
+            raise ValueError(
+                f"checkpointKeep must be >= 1, got {self.checkpoint_keep} "
+                "(retention counts checkpoints, 0 would silently fall back "
+                "to the default)"
+            )
+        return self
 
 
 class V1Program(BaseSchema):

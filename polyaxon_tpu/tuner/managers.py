@@ -220,8 +220,14 @@ class AshaManager(SearchManager):
         self.eta = float(matrix.eta)
         self.r_min = float(matrix.min_resource)
         self.r_max = float(matrix.max_resource)
+        # +1e-9: float log error must not drop the top rung (e.g.
+        # log(1000)/log(10) == 2.9999999999999996 would lose resource 1000)
         self.n_rungs = (
-            int(math.floor(math.log(self.r_max / self.r_min) / math.log(self.eta)))
+            int(
+                math.floor(
+                    math.log(self.r_max / self.r_min) / math.log(self.eta) + 1e-9
+                )
+            )
             + 1
         )
         # rung i → list of (key, score); key identifies a config across rungs

@@ -422,3 +422,25 @@ def test_mesh_model_axis_mismatch_friendly_error(tmp_home):
 
     with pytest.raises(ValueError, match="no\\s+.?experts"):
         Trainer(program, mesh_axes={"expert": 2, "data": 4})
+
+
+def test_data_model_shape_mismatch_is_clear():
+    """A dataset whose feature shape disagrees with the model must fail at
+    build time with a config-level message, not a flax scope error deep in
+    the first apply."""
+    import pytest
+
+    from polyaxon_tpu.runtime.trainer import Trainer
+
+    p = make_program()
+    p.model.config = {"input_dim": 16, "num_classes": 4, "hidden": [32]}
+    p.data.config = {"shape": [32], "num_classes": 4}
+    with pytest.raises(ValueError, match="data/model shape mismatch"):
+        Trainer(p, mesh_axes={"data": 8})
+
+    # flattening models compare by element count, not tuple equality:
+    # (28,28,1) into an mlp expecting (784,) is a valid, working config
+    p2 = make_program(steps=1, logEvery=1)
+    p2.model.config = {"input_dim": 784, "num_classes": 10, "hidden": [16]}
+    p2.data = p2.data.model_copy(update={"name": "mnist", "config": {"flat": False}})
+    Trainer(p2, mesh_axes={"data": 8})  # must not raise
