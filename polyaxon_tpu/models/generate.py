@@ -1,0 +1,121 @@
+"""Autoregressive generation with a per-layer KV cache.
+
+The training side of the LM family lives in runtime/trainer.py; this is
+the decode side: prompt prefill and token-by-token sampling through the
+transformer's `decode=True` path (models/transformer.py Attention), where
+each layer appends K/V into a cache variable and attends a single query
+against the filled prefix — O(S) per token instead of O(S^2).
+
+TPU-first shape discipline: the whole generation is ONE `lax.scan` of
+static length over a fixed-size token buffer, so XLA compiles a single
+program — no per-token retrace, no dynamic shapes. Prompt tokens are
+teacher-forced by position select; an optional `eos_id` freezes finished
+rows (they keep stepping but their output is pinned, branch-free).
+
+Usage:
+    bundle = build_model("transformer_lm", {...})
+    tokens = generate(bundle.module, params, prompt, max_new_tokens=32,
+                      temperature=0.8, top_k=40, seed=0)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+    """logits: [B, V] → [B] sampled token ids. temperature 0 = greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -1e30)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    module,
+    params,
+    prompt: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    eos_id: Optional[int] = None,
+    seed: int = 0,
+) -> jnp.ndarray:
+    """Generate `max_new_tokens` continuations of `prompt` [B, P] (int32).
+
+    Returns [B, P + max_new_tokens]. Prompt positions are teacher-forced
+    (prefill runs through the same cached decode steps), sampling starts
+    at position P. With `eos_id`, rows that emit it are padded with eos
+    from then on. Total length is capped by the model's cfg.seq_len (the
+    cache size).
+    """
+    cfg = module.cfg
+    B, P = prompt.shape
+    total = P + int(max_new_tokens)
+    if total > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds the model's seq_len {cfg.seq_len} (the KV cache size)"
+        )
+    prompt = prompt.astype(jnp.int32)
+
+    # cache creation pass: one dummy mutable apply materializes zeroed
+    # cache variables (flax recipe — variables appear on first mutable use)
+    _, init_vars = module.apply(
+        {"params": params},
+        jnp.zeros((B, 1), jnp.int32),
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+    # the creation pass fell through to full attention WITHOUT advancing
+    # cache_index, so the scan below starts cleanly at position 0
+    cache0 = init_vars["cache"]
+
+    buf = jnp.zeros((B, total), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    rng0 = jax.random.PRNGKey(seed)
+
+    def step(carry, t):
+        cache, buf, done = carry
+        tok = jax.lax.dynamic_slice(buf, (0, t), (B, 1))
+        logits, out_vars = module.apply(
+            {"params": params, "cache": cache},
+            tok,
+            train=False,
+            decode=True,
+            mutable=["cache"],
+        )
+        nxt = _sample(
+            logits[:, -1].astype(jnp.float32),
+            jax.random.fold_in(rng0, t),
+            temperature,
+            top_k,
+        )
+        if eos_id is not None:
+            # latch only on GENERATED eos (input positions >= P): prompts
+            # legitimately contain eos as separators and must not freeze
+            # the row before it produced anything
+            done = done | ((tok[:, 0] == eos_id) & (t >= P))
+            nxt = jnp.where(done, eos_id, nxt)
+        # positions < P keep the prompt (prefill); later ones take samples
+        keep_prompt = t + 1 < P
+        cur = jax.lax.dynamic_slice(buf, (0, t + 1), (B, 1))[:, 0]
+        write = jnp.where(keep_prompt, cur, nxt)
+        buf = jax.lax.dynamic_update_slice(
+            buf, write[:, None], (0, t + 1)
+        )
+        return (out_vars["cache"], buf, done), None
+
+    done0 = jnp.zeros((B,), bool)
+    (_, buf, _), _ = jax.lax.scan(
+        step, (cache0, buf, done0), jnp.arange(total - 1)
+    )
+    return buf

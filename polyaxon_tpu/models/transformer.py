@@ -133,7 +133,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, decode: bool = False):
         cfg = self.cfg
         B, S, _ = x.shape
         hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -148,6 +148,59 @@ class Attention(nn.Module):
         v = constrain(v, BATCH, "context", "model", None)
         cos_np, sin_np = rope_table(cfg.seq_len, hd, cfg.rope_theta)
         cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+
+        if decode:
+            # autoregressive step: append this token's K/V into a per-layer
+            # cache and attend the single query against the filled prefix.
+            # Standard flax recipe — variables materialize on the first
+            # mutable("cache") apply; cache holds nkv (pre-GQA) heads.
+            is_step = self.has_variable("cache", "cached_key")
+            cached_k = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros((B, cfg.seq_len, nkv, hd), k.dtype),
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros((B, cfg.seq_len, nkv, hd), v.dtype),
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if is_step:
+                if S != 1:
+                    raise ValueError(
+                        f"decode steps take one token at a time, got S={S}"
+                    )
+                pos = cache_index.value
+                q = apply_rope(q, cos, sin, offset=pos)
+                k = apply_rope(k, cos, sin, offset=pos)
+                k_all = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, pos, 0, 0)
+                )
+                v_all = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, pos, 0, 0)
+                )
+                cached_k.value, cached_v.value = k_all, v_all
+                cache_index.value = pos + 1
+                if nkv != nh:
+                    rep = nh // nkv
+                    k_all = jnp.repeat(k_all, rep, axis=2)
+                    v_all = jnp.repeat(v_all, rep, axis=2)
+                # single-query attention against the prefix, masked past pos
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, k_all,
+                    preferred_element_type=jnp.float32,
+                ) / np.sqrt(hd)
+                live = jnp.arange(cfg.seq_len) <= pos
+                scores = jnp.where(live[None, None, None, :], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+                out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+                return _proj(cfg, cfg.dim, "o_proj")(
+                    out.reshape(B, S, nh * hd)
+                )
+            # cache creation pass (first mutable apply): fall through to the
+            # ordinary full-sequence attention so output shapes are normal
+
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if nkv != nh:  # GQA: expand kv heads to query-head count
@@ -187,6 +240,7 @@ class FeedForward(nn.Module):
 class Block(nn.Module):
     cfg: TransformerConfig
     train: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -195,7 +249,9 @@ class Block(nn.Module):
         cfg = self.cfg
         x = constrain(x, BATCH, "context", None)
         h = Attention(cfg, name="attention")(
-            RMSNorm(cfg.norm_eps, name="attention_norm")(x), train=self.train
+            RMSNorm(cfg.norm_eps, name="attention_norm")(x),
+            train=self.train,
+            decode=self.decode,
         )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
@@ -224,10 +280,11 @@ class _ScanBlock(nn.Module):
 
     cfg: TransformerConfig
     train: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, _):
-        return Block(self.cfg, self.train, name="block")(x), None
+        return Block(self.cfg, self.train, self.decode, name="block")(x), None
 
 
 class PipelinedLayers(nn.Module):
@@ -293,8 +350,14 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
         cfg = self.cfg
+        if decode and cfg.pipeline_stages > 1:
+            raise ValueError(
+                "KV-cache decode is not supported with pipeline_stages > 1 "
+                "(the stage-stacked weights have no per-layer cache slots); "
+                "generate with a non-pipelined copy of the params"
+            )
         embed = nn.Embed(
             cfg.vocab_size,
             cfg.dim,
@@ -307,14 +370,14 @@ class Transformer(nn.Module):
         elif cfg.scan_layers:
             Layers = nn.scan(
                 _ScanBlock,
-                variable_axes={"params": 0, "losses": 0},
+                variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
             )
-            x, _ = Layers(cfg, train, name="layers")(x, None)
+            x, _ = Layers(cfg, train, decode, name="layers")(x, None)
         else:
             for i in range(cfg.n_layers):
-                x = Block(cfg, train, name=f"layer_{i}")(x)
+                x = Block(cfg, train, decode, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if cfg.tie_embeddings:
             return embed.attend(x.astype(jnp.float32))

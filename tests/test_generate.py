@@ -1,0 +1,101 @@
+"""Autoregressive decode: the KV-cache path must reproduce full-reforward
+greedy decoding exactly, across layer-stacking modes and GQA."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.models.generate import generate
+
+
+def _setup(**cfg_overrides):
+    cfg = {
+        "preset": "tiny", "seq_len": 64, "n_layers": 2, "dim": 64,
+        "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128,
+    }
+    cfg.update(cfg_overrides)
+    b = build_model("transformer_lm", cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (2, 5), 0, 128, dtype=jnp.int32)
+    params = b.module.init(
+        {"params": rng}, jnp.zeros((2, 64), jnp.int32), train=False
+    )["params"]
+    return b.module, params, prompt
+
+
+def _naive_greedy(module, params, prompt, n):
+    toks = np.asarray(prompt)
+    for _ in range(n):
+        logits = module.apply({"params": params}, jnp.asarray(toks), train=False)
+        nxt = np.argmax(np.asarray(logits[:, -1], np.float32), -1)
+        toks = np.concatenate([toks, nxt[:, None].astype(np.int32)], 1)
+    return toks
+
+
+@pytest.mark.parametrize("mode", ["layers", "scan"])
+def test_cached_decode_matches_full_reforward(mode):
+    module, params, prompt = _setup(scan_layers=(mode == "scan"))
+    out = generate(module, params, prompt, max_new_tokens=8, temperature=0.0)
+    ref = _naive_greedy(module, params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_sampling_reproducible_and_bounded():
+    module, params, prompt = _setup()
+    a = generate(module, params, prompt, max_new_tokens=6,
+                 temperature=0.8, top_k=10, seed=7)
+    b = generate(module, params, prompt, max_new_tokens=6,
+                 temperature=0.8, top_k=10, seed=7)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = generate(module, params, prompt, max_new_tokens=6,
+                 temperature=0.8, top_k=10, seed=8)
+    assert (np.asarray(a) != np.asarray(c)).any()
+    assert np.asarray(a).min() >= 0 and np.asarray(a).max() < 128
+    # prompt is preserved verbatim
+    np.testing.assert_array_equal(np.asarray(a)[:, :5], np.asarray(prompt))
+
+
+def test_eos_freezes_finished_rows():
+    module, params, prompt = _setup()
+    eos = 3
+    out = np.asarray(
+        generate(module, params, prompt, max_new_tokens=12,
+                 temperature=0.9, eos_id=eos, seed=1)
+    )
+    for row in out:
+        gen = row[5:]
+        hits = np.where(gen == eos)[0]
+        if hits.size:  # everything after the first eos is eos
+            assert (gen[hits[0]:] == eos).all()
+
+
+def test_eos_in_prompt_does_not_freeze_generation():
+    """Prompts legitimately contain eos as separators (chat templates,
+    packed documents); only a GENERATED eos may finish a row."""
+    module, params, prompt = _setup()
+    eos = int(np.asarray(prompt)[0, 2])  # an eos that occurs mid-prompt
+    out = np.asarray(
+        generate(module, params, prompt, max_new_tokens=8,
+                 temperature=0.0, eos_id=eos, seed=0)
+    )
+    ref = _naive_greedy(module, params, prompt, 8)
+    # greedy continuation of row 0 must match eos-free decoding up to the
+    # first GENERATED eos (if any) — not be frozen to eos from position P
+    gen, ref_gen = out[0, 5:], ref[0, 5:]
+    first = np.where(ref_gen == eos)[0]
+    upto = first[0] + 1 if first.size else len(ref_gen)
+    np.testing.assert_array_equal(gen[:upto], ref_gen[:upto])
+    assert not (gen == eos).all(), "row frozen by prompt eos"
+
+
+def test_generate_overflow_and_pipeline_errors():
+    module, params, prompt = _setup()
+    with pytest.raises(ValueError, match="exceeds the model's seq_len"):
+        generate(module, params, prompt, max_new_tokens=100)
+    mod2, params2, prompt2 = _setup(
+        pipeline_stages=2, pipeline_microbatches=2
+    )
+    with pytest.raises(ValueError, match="pipeline"):
+        generate(mod2, params2, prompt2, max_new_tokens=4)
