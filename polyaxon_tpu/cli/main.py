@@ -380,6 +380,37 @@ def agent_drain(queues):
 
 
 @cli.command()
+@click.option("-uid", "--uid", required=True, help="run to serve (uuid/prefix/name)")
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8601, type=int)
+def serve(uid, host, port):
+    """Serve a checkpointed LM run's generation over HTTP
+    (GET /healthz, POST /generate)."""
+    from ..serving import ModelServer
+    from ..serving.server import ServingError
+
+    try:
+        server = ModelServer.from_run(uid)
+    except (ServingError, KeyError) as e:
+        raise click.ClickException(str(e.args[0]) if e.args else str(e))
+    bound = server.start(host=host, port=port)
+    click.echo(
+        f"serving {server.model_name} (step {server.step}) "
+        f"on http://{host}:{bound} — POST /generate, GET /healthz"
+    )
+    import signal
+    import threading
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.stop()
+
+
+@cli.command()
 @click.option("-f", "--file", "fpath", required=True, type=click.Path(exists=True))
 @click.option("-P", "--param", "params", multiple=True, help="override: name=value")
 @click.option("--namespace", default="polyaxon")

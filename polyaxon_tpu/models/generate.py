@@ -46,7 +46,8 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     eos_id: Optional[int] = None,
-    seed: int = 0,
+    seed=0,  # int, or a traced int32 scalar (jit-friendly: shape-static fns
+    # can take the seed as a runtime argument instead of recompiling per seed)
 ) -> jnp.ndarray:
     """Generate `max_new_tokens` continuations of `prompt` [B, P] (int32).
 

@@ -1,0 +1,1 @@
+from .server import ModelServer  # noqa: F401

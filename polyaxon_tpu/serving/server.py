@@ -1,0 +1,223 @@
+"""Model serving: load a finished run's checkpoint, serve generation.
+
+The reference's `service` run kind serves user containers (dashboards,
+notebooks); this module gives the native LM family its inference surface —
+a checkpointed `transformer_lm` run becomes an HTTP endpoint in one
+command:
+
+    polyaxon serve --uid <run> --port 8601
+    curl -X POST localhost:8601/generate -d '{"tokens": [[1,2,3]], "maxNewTokens": 16}'
+
+Endpoints:
+  GET  /healthz           → {"status": "ok", "model": ..., "step": N}
+  POST /generate          → {"tokens": [[...]]}
+     body: {"tokens": [[int]], "maxNewTokens": int, "temperature": float,
+            "topK": int?, "eosId": int?, "seed": int?}
+
+Design: the server owns ONE jitted decode program per (batch, prompt_len,
+max_new) shape triple (generate() is a single static-length lax.scan);
+repeated calls with the same shape reuse the compiled program. Serving is
+read-only — params are restored once at startup.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..store.local import RunStore
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+class ModelServer:
+    def __init__(self, module, params, *, model_name: str = "?", step: int = 0):
+        self.module = module
+        self.params = params
+        self.model_name = model_name
+        self.step = step
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # one jitted decode program per (shape, sampling) signature — seed
+        # is a runtime argument so same-shape requests reuse the compile.
+        # Guarded: requests come from the HTTP thread pool and jax tracing
+        # is not re-entrant.
+        self._compiled: dict = {}
+        self._lock = threading.Lock()
+
+    def _decode_fn(self, batch, prompt_len, max_new, temperature, top_k, eos_id):
+        import jax
+
+        from ..models.generate import generate
+
+        key = (batch, prompt_len, max_new, temperature, top_k, eos_id)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda params, prompt, seed: generate(
+                    self.module,
+                    params,
+                    prompt,
+                    max_new_tokens=max_new,
+                    temperature=temperature,
+                    top_k=top_k,
+                    eos_id=eos_id,
+                    seed=seed,
+                )
+            )
+            self._compiled[key] = fn
+        return fn
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def from_run(cls, run_ref: str, store: Optional[RunStore] = None):
+        """Restore the latest checkpoint of a `transformer_lm` jaxjob run.
+
+        Rebuilds the trainer from the run's stored spec (same code path the
+        executor used), restores TrainState, and serves its params."""
+        import jax
+
+        from ..runtime.trainer import Trainer
+        from ..schemas.run_kinds import V1JAXJob
+
+        store = store or RunStore()
+        uuid = store.resolve(run_ref)
+        spec = store.read_spec(uuid)
+        run = (spec.get("component") or {}).get("run") or {}
+        if run.get("kind") != "jaxjob" or not run.get("program"):
+            raise ServingError(
+                f"run {uuid[:8]} is not a native jaxjob program run"
+            )
+        run_spec = V1JAXJob.model_validate(run)
+        program = run_spec.program
+        if program.model.name not in ("transformer_lm",):
+            raise ServingError(
+                f"serving supports the LM family (transformer_lm), run "
+                f"{uuid[:8]} trained {program.model.name!r}"
+            )
+        ckpt_dir = store.outputs_dir(uuid) / "checkpoints"
+        if not ckpt_dir.is_dir():
+            raise ServingError(
+                f"run {uuid[:8]} has no checkpoints under its outputs — "
+                "train with train.checkpointEvery set"
+            )
+        trainer = Trainer(
+            program,
+            devices=[jax.devices()[0]],
+            checkpoint_dir=str(ckpt_dir),
+        )
+        step = trainer.restore()
+        if step == 0:
+            raise ServingError(f"no restorable checkpoint in {ckpt_dir}")
+        return cls(
+            trainer.bundle.module,
+            trainer.state.params,
+            model_name=program.model.name,
+            step=step,
+        )
+
+    # ------------------------------------------------------------ compute
+    def generate(self, body: dict) -> dict:
+        import jax.numpy as jnp
+        import numpy as np
+
+        tokens = body.get("tokens")
+        if not tokens or not isinstance(tokens, list):
+            raise ServingError("body.tokens must be a non-empty [[int]] batch")
+        max_new = int(body.get("maxNewTokens", 16))
+        if max_new < 1:
+            raise ServingError("maxNewTokens must be >= 1")
+        try:
+            arr = np.asarray(tokens, dtype=np.int32)
+        except (ValueError, TypeError) as e:
+            raise ServingError(f"tokens must be rectangular [[int]]: {e}")
+        if arr.ndim != 2:
+            raise ServingError("tokens must be rectangular [[int]]")
+        cfg = self.module.cfg
+        if arr.min() < 0 or arr.max() >= cfg.vocab_size:
+            raise ServingError(
+                f"token ids must be in [0, {cfg.vocab_size}); "
+                f"got range [{arr.min()}, {arr.max()}]"
+            )
+        if arr.shape[1] + max_new > cfg.seq_len:
+            raise ServingError(
+                f"prompt ({arr.shape[1]}) + maxNewTokens ({max_new}) exceeds "
+                f"the model's seq_len {cfg.seq_len}"
+            )
+        top_k = body.get("topK")
+        eos = body.get("eosId")
+        with self._lock:
+            fn = self._decode_fn(
+                arr.shape[0],
+                arr.shape[1],
+                max_new,
+                float(body.get("temperature", 0.0)),
+                int(top_k) if top_k is not None else None,
+                int(eos) if eos is not None else None,
+            )
+            out = fn(
+                self.params,
+                jnp.asarray(arr),
+                jnp.asarray(int(body.get("seed", 0)), jnp.int32),
+            )
+        return {"tokens": np.asarray(out).tolist()}
+
+    # ------------------------------------------------------------ http
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start serving in a background thread; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(
+                        200,
+                        {
+                            "status": "ok",
+                            "model": server.model_name,
+                            "step": server.step,
+                        },
+                    )
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    self._send(200, server.generate(body))
+                except ServingError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — surface, don't kill
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
