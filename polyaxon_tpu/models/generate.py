@@ -6,11 +6,12 @@ transformer's `decode=True` path (models/transformer.py Attention), where
 each layer appends K/V into a cache variable and attends a single query
 against the filled prefix — O(S) per token instead of O(S^2).
 
-TPU-first shape discipline: the whole generation is ONE `lax.scan` of
-static length over a fixed-size token buffer, so XLA compiles a single
-program — no per-token retrace, no dynamic shapes. Prompt tokens are
-teacher-forced by position select; an optional `eos_id` freezes finished
-rows (they keep stepping but their output is pinned, branch-free).
+TPU-first shape discipline: ONE batched prefill forward (the whole prompt
+at once, filling every layer's cache and sampling the first new token)
+followed by ONE static-length `lax.scan` over the generated positions —
+two compiled programs total, no per-token retrace, no dynamic shapes. An
+optional `eos_id` freezes finished rows (they keep stepping but their
+output is pinned, branch-free).
 
 Usage:
     bundle = build_model("transformer_lm", {...})
@@ -77,14 +78,31 @@ def generate(
         mutable=["cache"],
     )
     # the creation pass fell through to full attention WITHOUT advancing
-    # cache_index, so the scan below starts cleanly at position 0
+    # cache_index, so prefill below starts cleanly at position 0
     cache0 = init_vars["cache"]
+
+    # batched prefill: the whole prompt in ONE forward that fills the
+    # cache; its last-position logits sample the first new token
+    logits, vars1 = module.apply(
+        {"params": params, "cache": cache0},
+        prompt,
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+    rng0 = jax.random.PRNGKey(seed)
+    first = _sample(
+        logits[:, -1].astype(jnp.float32),
+        jax.random.fold_in(rng0, 0),
+        temperature,
+        top_k,
+    )
 
     buf = jnp.zeros((B, total), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
-    rng0 = jax.random.PRNGKey(seed)
+    buf = buf.at[:, P].set(first)
 
-    def step(carry, t):
+    def step(carry, t):  # t = position of the token being fed (>= P)
         cache, buf, done = carry
         tok = jax.lax.dynamic_slice(buf, (0, t), (B, 1))
         logits, out_vars = module.apply(
@@ -101,22 +119,19 @@ def generate(
             top_k,
         )
         if eos_id is not None:
-            # latch only on GENERATED eos (input positions >= P): prompts
-            # legitimately contain eos as separators and must not freeze
-            # the row before it produced anything
-            done = done | ((tok[:, 0] == eos_id) & (t >= P))
+            # latch only on GENERATED eos: the fed token at position >= P
+            # is always model output; prompts legitimately contain eos as
+            # separators and never enter this loop
+            done = done | (tok[:, 0] == eos_id)
             nxt = jnp.where(done, eos_id, nxt)
-        # positions < P keep the prompt (prefill); later ones take samples
-        keep_prompt = t + 1 < P
-        cur = jax.lax.dynamic_slice(buf, (0, t + 1), (B, 1))[:, 0]
-        write = jnp.where(keep_prompt, cur, nxt)
-        buf = jax.lax.dynamic_update_slice(
-            buf, write[:, None], (0, t + 1)
-        )
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, t + 1))
         return (out_vars["cache"], buf, done), None
 
     done0 = jnp.zeros((B,), bool)
-    (_, buf, _), _ = jax.lax.scan(
-        step, (cache0, buf, done0), jnp.arange(total - 1)
-    )
+    if max_new_tokens > 1:
+        (_, buf, _), _ = jax.lax.scan(
+            step,
+            (vars1["cache"], buf, done0),
+            jnp.arange(P, total - 1),
+        )
     return buf

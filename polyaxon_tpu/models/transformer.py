@@ -167,10 +167,10 @@ class Attention(nn.Module):
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
             if is_step:
-                if S != 1:
-                    raise ValueError(
-                        f"decode steps take one token at a time, got S={S}"
-                    )
+                # S == 1: one sampled token; S > 1: batched PREFILL — the
+                # whole prompt in one pass that also fills the cache, so
+                # generation costs 1 forward + (new-1) cached steps instead
+                # of (P + new - 1) sequential steps
                 pos = cache_index.value
                 q = apply_rope(q, cos, sin, offset=pos)
                 k = apply_rope(k, cos, sin, offset=pos)
@@ -181,18 +181,21 @@ class Attention(nn.Module):
                     cached_v.value, v, (0, pos, 0, 0)
                 )
                 cached_k.value, cached_v.value = k_all, v_all
-                cache_index.value = pos + 1
+                cache_index.value = pos + S
                 if nkv != nh:
                     rep = nh // nkv
                     k_all = jnp.repeat(k_all, rep, axis=2)
                     v_all = jnp.repeat(v_all, rep, axis=2)
-                # single-query attention against the prefix, masked past pos
+                # query row i may see cache positions <= pos + i
                 scores = jnp.einsum(
                     "bqhd,bkhd->bhqk", q, k_all,
                     preferred_element_type=jnp.float32,
                 ) / np.sqrt(hd)
-                live = jnp.arange(cfg.seq_len) <= pos
-                scores = jnp.where(live[None, None, None, :], scores, -1e30)
+                live = (
+                    jnp.arange(cfg.seq_len)[None, :]
+                    <= (pos + jnp.arange(S))[:, None]
+                )
+                scores = jnp.where(live[None, None, :, :], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
                 out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
                 return _proj(cfg, cfg.dim, "o_proj")(

@@ -44,9 +44,14 @@ class ModelServer:
         self._thread: Optional[threading.Thread] = None
         # one jitted decode program per (shape, sampling) signature — seed
         # is a runtime argument so same-shape requests reuse the compile.
-        # Guarded: requests come from the HTTP thread pool and jax tracing
-        # is not re-entrant.
-        self._compiled: dict = {}
+        # LRU-bounded: the key embeds client-controlled values (shapes,
+        # temperature), so an unbounded dict would leak a compiled XLA
+        # program per novel request. Guarded: requests come from the HTTP
+        # thread pool and jax tracing is not re-entrant.
+        import collections
+
+        self._compiled: collections.OrderedDict = collections.OrderedDict()
+        self._compiled_max = 32
         self._lock = threading.Lock()
 
     def _decode_fn(self, batch, prompt_len, max_new, temperature, top_k, eos_id):
@@ -56,6 +61,8 @@ class ModelServer:
 
         key = (batch, prompt_len, max_new, temperature, top_k, eos_id)
         fn = self._compiled.get(key)
+        if fn is not None:
+            self._compiled.move_to_end(key)
         if fn is None:
             fn = jax.jit(
                 lambda params, prompt, seed: generate(
@@ -70,6 +77,8 @@ class ModelServer:
                 )
             )
             self._compiled[key] = fn
+            while len(self._compiled) > self._compiled_max:
+                self._compiled.popitem(last=False)
         return fn
 
     # ------------------------------------------------------------ loading
@@ -135,8 +144,10 @@ class ModelServer:
             arr = np.asarray(tokens, dtype=np.int32)
         except (ValueError, TypeError) as e:
             raise ServingError(f"tokens must be rectangular [[int]]: {e}")
-        if arr.ndim != 2:
-            raise ServingError("tokens must be rectangular [[int]]")
+        if arr.ndim != 2 or arr.shape[1] < 1:
+            raise ServingError(
+                "tokens must be rectangular [[int]] with >= 1 token per row"
+            )
         cfg = self.module.cfg
         if arr.min() < 0 or arr.max() >= cfg.vocab_size:
             raise ServingError(

@@ -102,6 +102,7 @@ def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
         # bad requests surface as 400 with a message, not a 500
         for bad in (
             {"tokens": []},
+            {"tokens": [[]]},  # empty row
             {"tokens": [[1, 2], [3]]},  # ragged
             {"tokens": [[1, 2, 3]], "maxNewTokens": 100},  # > seq_len
             {"tokens": [[999999]]},  # out of vocab
