@@ -34,11 +34,16 @@ def _naive_greedy(module, params, prompt, n):
     return toks
 
 
-@pytest.mark.parametrize("mode", ["layers", "scan"])
+@pytest.mark.parametrize(
+    "mode",
+    ["layers", pytest.param("scan", marks=pytest.mark.slow)],
+)
 def test_cached_decode_matches_full_reforward(mode):
+    # 5 tokens exercise prefill + 4 cached steps; the naive reference
+    # recompiles per length, so keep the tail short in the fast tier
     module, params, prompt = _setup(scan_layers=(mode == "scan"))
-    out = generate(module, params, prompt, max_new_tokens=8, temperature=0.0)
-    ref = _naive_greedy(module, params, prompt, 8)
+    out = generate(module, params, prompt, max_new_tokens=5, temperature=0.0)
+    ref = _naive_greedy(module, params, prompt, 5)
     np.testing.assert_array_equal(np.asarray(out), ref)
 
 
@@ -90,10 +95,14 @@ def test_eos_in_prompt_does_not_freeze_generation():
     assert not (gen == eos).all(), "row frozen by prompt eos"
 
 
-def test_generate_overflow_and_pipeline_errors():
+def test_generate_overflow_errors():
     module, params, prompt = _setup()
     with pytest.raises(ValueError, match="exceeds the model's seq_len"):
         generate(module, params, prompt, max_new_tokens=100)
+
+
+@pytest.mark.slow
+def test_generate_pipeline_error():
     mod2, params2, prompt2 = _setup(
         pipeline_stages=2, pipeline_microbatches=2
     )

@@ -15,6 +15,11 @@ from polyaxon_tpu.serving import ModelServer
 from polyaxon_tpu.serving.server import ServingError
 from polyaxon_tpu.store import RunStore
 
+
+def test_from_run_unknown_ref_fast(tmp_home):
+    with pytest.raises(KeyError):
+        ModelServer.from_run("nope", store=RunStore())
+
 SPEC = {
     "version": 1.1,
     "kind": "operation",
@@ -114,6 +119,7 @@ def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
         server.stop()
 
 
+@pytest.mark.slow
 def test_from_run_errors(tmp_home, tmp_path):
     store = RunStore()
     with pytest.raises(KeyError):

@@ -540,6 +540,7 @@ def test_asha_budget_and_rung_cap():
     assert sum(row["finished"] for row in table) <= 19
 
 
+@pytest.mark.slow
 def test_asha_sweep_end_to_end(tmp_home, tmp_path):
     """ASHA through the real sweep driver: trials execute, the best config
     wins, and higher rungs re-run good configs at more steps."""
