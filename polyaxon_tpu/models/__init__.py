@@ -12,4 +12,4 @@ from . import resnet  # noqa: F401
 from . import seq2seq  # noqa: F401
 from . import transformer  # noqa: F401
 from . import vit  # noqa: F401
-from .generate import generate  # noqa: F401,E402 — decode-side public API
+from .generate import beam_search, generate  # noqa: F401,E402 — decode-side public API

@@ -135,3 +135,157 @@ def generate(
             jnp.arange(P, total - 1),
         )
     return buf
+
+
+def beam_search(
+    module,
+    params,
+    prompt: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_id: Optional[int] = None,
+) -> jnp.ndarray:
+    """Beam-search decode: returns the best sequence per batch row,
+    [B, P + max_new_tokens].
+
+    Same compiled-shape discipline as generate(): one prefill on the
+    prompt (computed once per batch row, then tiled to beams), then a
+    static-length scan where each step expands every beam over the vocab,
+    keeps the top `num_beams` continuations, and reorders the KV cache by
+    each survivor's parent beam (a batch-dim gather on the cache pytree).
+
+    Scoring follows the canonical recipe: mid-scan pruning ranks beams by
+    RAW accumulated log-prob (a finished beam can be evicted by higher-raw
+    live beams — no separate finished-hypothesis buffer is kept), and
+    `length_penalty` applies only to the FINAL ranking among the nb
+    survivors (dividing by length**length_penalty; >1 favors longer).
+    With `eos_id`, finished beams freeze: forced eos, no score change."""
+    cfg = module.cfg
+    B, P = prompt.shape
+    total = P + int(max_new_tokens)
+    if total > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds the model's seq_len {cfg.seq_len} (the KV cache size)"
+        )
+    nb = int(num_beams)
+    if nb < 1:
+        raise ValueError("num_beams must be >= 1")
+    if nb > cfg.vocab_size:
+        raise ValueError(
+            f"num_beams ({nb}) cannot exceed vocab_size ({cfg.vocab_size})"
+        )
+    prompt = prompt.astype(jnp.int32)
+    BN = B * nb
+
+    def tile(x):  # [B, ...] -> [B*nb, ...] (beam-major per batch row)
+        return jnp.repeat(x, nb, axis=0)
+
+    # cache creation + prefill ONCE per batch row ([B, P] — all nb beams
+    # of a row share the prefix state), then tile the cache to beams;
+    # prefilling the tiled batch would cost nb x the FLOPs for identical
+    # outputs
+    _, init_vars = module.apply(
+        {"params": params},
+        jnp.zeros((B, 1), jnp.int32),
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+    logits, vars1 = module.apply(
+        {"params": params, "cache": init_vars["cache"]},
+        prompt,
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+    # cache batch axis: 0 in the per-layer module layout, 1 under
+    # nn.scan-over-layers (leaves gain a leading [n_layers] dim). K/V
+    # leaves have ndim >= 3; cache_index ((), or [n_layers] under scan)
+    # is beam-invariant and is never tiled or gathered.
+    cache_batch_axis = 1 if getattr(cfg, "scan_layers", False) else 0
+
+    def beam_cache_map(fn, tree):
+        return jax.tree.map(
+            lambda c: fn(c) if hasattr(c, "ndim") and c.ndim >= 3 else c,
+            tree,
+        )
+
+    cache0 = beam_cache_map(
+        lambda c: jnp.repeat(c, nb, axis=cache_batch_axis), vars1["cache"]
+    )
+    first_logp = jax.nn.log_softmax(
+        logits[:, -1].astype(jnp.float32), axis=-1
+    )  # [B, V]
+    V = first_logp.shape[-1]
+    # first expansion: row's beams take the top-nb distinct first tokens
+    scores0, tok0 = jax.lax.top_k(first_logp, nb)  # [B, nb]
+
+    buf = jnp.zeros((BN, total), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, tile(prompt), (0, 0))
+    buf = buf.at[:, P].set(tok0.reshape(BN))
+
+    def gather_rows(x, flat, axis):
+        return jnp.take(x, flat, axis=axis)
+
+    def gather_beams_cache(tree, parent):  # parent: [B, nb]
+        flat = (jnp.arange(B)[:, None] * nb + parent).reshape(BN)
+        return beam_cache_map(
+            lambda c: gather_rows(c, flat, cache_batch_axis), tree
+        )
+
+    def step(carry, t):
+        cache, buf, scores, done = carry  # scores/done: [B, nb]
+        tok = jax.lax.dynamic_slice(buf, (0, t), (BN, 1))
+        logits, out_vars = module.apply(
+            {"params": params, "cache": cache},
+            tok,
+            train=False,
+            decode=True,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).reshape(B, nb, V)
+        if eos_id is not None:
+            done = done | (tok.reshape(B, nb) == eos_id)
+            # a finished beam only continues as eos, at no score change
+            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+            logp = jnp.where(done[:, :, None], frozen[None, None, :], logp)
+        cand = scores[:, :, None] + logp  # [B, nb, V]
+        scores, idx = jax.lax.top_k(cand.reshape(B, nb * V), nb)
+        parent, nxt = idx // V, (idx % V).astype(jnp.int32)  # [B, nb]
+        flat = (jnp.arange(B)[:, None] * nb + parent).reshape(BN)
+        cache = gather_beams_cache(out_vars["cache"], parent)
+        buf = buf[flat]
+        done = jnp.take_along_axis(done, parent, axis=1)
+        buf = jax.lax.dynamic_update_slice(
+            buf, nxt.reshape(BN, 1), (0, t + 1)
+        )
+        return (cache, buf, scores, done), None
+
+    done0 = (
+        (tok0 == eos_id) if eos_id is not None else jnp.zeros((B, nb), bool)
+    )
+    carry = (cache0, buf, scores0, done0)
+    if max_new_tokens > 1:
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(P, total - 1))
+    _, buf, scores, done = carry
+
+    # length-normalized selection: a beam's generated length is max_new for
+    # unfinished beams, or its first-eos offset for finished ones
+    out = buf.reshape(B, nb, total)
+    gen = out[:, :, P:]
+    if eos_id is not None:
+        is_eos = gen == eos_id
+        first_eos = jnp.where(
+            is_eos.any(-1), jnp.argmax(is_eos, -1) + 1, max_new_tokens
+        )
+        lengths = first_eos.astype(jnp.float32)
+    else:
+        lengths = jnp.full((B, nb), float(max_new_tokens))
+    final = scores / (lengths ** float(length_penalty))
+    best = jnp.argmax(final, axis=1)
+    return jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]

@@ -12,7 +12,8 @@ Endpoints:
   GET  /healthz           → {"status": "ok", "model": ..., "step": N}
   POST /generate          → {"tokens": [[...]]}
      body: {"tokens": [[int]], "maxNewTokens": int, "temperature": float,
-            "topK": int?, "eosId": int?, "seed": int?}
+            "topK": int?, "eosId": int?, "seed": int?,
+            "numBeams": int? (beam search when > 1), "lengthPenalty": float?}
 
 Design: the server owns ONE jitted decode program per (batch, prompt_len,
 max_new) shape triple (generate() is a single static-length lax.scan);
@@ -54,28 +55,55 @@ class ModelServer:
         self._compiled_max = 32
         self._lock = threading.Lock()
 
-    def _decode_fn(self, batch, prompt_len, max_new, temperature, top_k, eos_id):
+    def _decode_fn(
+        self, batch, prompt_len, max_new, temperature, top_k, eos_id,
+        num_beams=1, length_penalty=1.0,
+    ):
         import jax
 
-        from ..models.generate import generate
+        from ..models.generate import beam_search, generate
 
-        key = (batch, prompt_len, max_new, temperature, top_k, eos_id)
+        # normalize the key to what the chosen path actually uses —
+        # beam search ignores temperature/top_k, sampling ignores
+        # length_penalty; without this, equivalent requests compile
+        # byte-identical duplicate programs and churn the LRU
+        if num_beams > 1:
+            temperature, top_k = 0.0, None
+        else:
+            length_penalty = 1.0
+        key = (
+            batch, prompt_len, max_new, temperature, top_k, eos_id,
+            num_beams, length_penalty,
+        )
         fn = self._compiled.get(key)
         if fn is not None:
             self._compiled.move_to_end(key)
         if fn is None:
-            fn = jax.jit(
-                lambda params, prompt, seed: generate(
-                    self.module,
-                    params,
-                    prompt,
-                    max_new_tokens=max_new,
-                    temperature=temperature,
-                    top_k=top_k,
-                    eos_id=eos_id,
-                    seed=seed,
+            if num_beams > 1:
+                fn = jax.jit(
+                    lambda params, prompt, seed: beam_search(
+                        self.module,
+                        params,
+                        prompt,
+                        max_new_tokens=max_new,
+                        num_beams=num_beams,
+                        length_penalty=length_penalty,
+                        eos_id=eos_id,
+                    )
                 )
-            )
+            else:
+                fn = jax.jit(
+                    lambda params, prompt, seed: generate(
+                        self.module,
+                        params,
+                        prompt,
+                        max_new_tokens=max_new,
+                        temperature=temperature,
+                        top_k=top_k,
+                        eos_id=eos_id,
+                        seed=seed,
+                    )
+                )
             self._compiled[key] = fn
             while len(self._compiled) > self._compiled_max:
                 self._compiled.popitem(last=False)
@@ -161,6 +189,14 @@ class ModelServer:
             )
         top_k = body.get("topK")
         eos = body.get("eosId")
+        num_beams = int(body.get("numBeams", 1))
+        # hard cap: numBeams is client-controlled and multiplies the KV
+        # cache and candidate tensors — unbounded values are a remote OOM
+        max_beams = min(32, cfg.vocab_size)
+        if not 1 <= num_beams <= max_beams:
+            raise ServingError(
+                f"numBeams must be in [1, {max_beams}]"
+            )
         with self._lock:
             fn = self._decode_fn(
                 arr.shape[0],
@@ -169,6 +205,8 @@ class ModelServer:
                 float(body.get("temperature", 0.0)),
                 int(top_k) if top_k is not None else None,
                 int(eos) if eos is not None else None,
+                num_beams=num_beams,
+                length_penalty=float(body.get("lengthPenalty", 1.0)),
             )
             out = fn(
                 self.params,

@@ -153,3 +153,47 @@ def test_mesh_sharded_params_decode_matches_single_device(tmp_home):
                  max_new_tokens=6, temperature=0.0)
     )
     np.testing.assert_array_equal(out_mesh, out_one)
+
+
+@pytest.mark.parametrize(
+    "mode",
+    ["layers", pytest.param("scan", marks=pytest.mark.slow)],
+)
+def test_beam_search_one_beam_equals_greedy(mode):
+    module, params, prompt = _setup(scan_layers=(mode == "scan"))
+    from polyaxon_tpu.models.generate import beam_search
+
+    g = np.asarray(generate(module, params, prompt, max_new_tokens=5,
+                            temperature=0.0))
+    b1 = np.asarray(beam_search(module, params, prompt, max_new_tokens=5,
+                                num_beams=1))
+    np.testing.assert_array_equal(g, b1)
+
+
+@pytest.mark.slow
+def test_beam_search_beats_or_ties_greedy_logprob():
+    """The point of beam search: the returned sequence's accumulated
+    log-prob (scored independently by full re-forward) is >= greedy's."""
+    from polyaxon_tpu.models.generate import beam_search
+
+    module, params, prompt = _setup()
+    n = 6
+    g = np.asarray(generate(module, params, prompt, max_new_tokens=n,
+                            temperature=0.0))
+    b4 = np.asarray(beam_search(module, params, prompt, max_new_tokens=n,
+                                num_beams=4))
+
+    def seq_logprob(toks):
+        lp = 0.0
+        for i in range(5, toks.shape[0]):
+            logits = module.apply(
+                {"params": params}, jnp.asarray(toks[None, :i]), train=False
+            )
+            lsm = np.asarray(
+                jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+            )
+            lp += lsm[toks[i]]
+        return lp
+
+    for r in range(g.shape[0]):
+        assert seq_logprob(b4[r]) >= seq_logprob(g[r]) - 1e-4

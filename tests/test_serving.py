@@ -104,6 +104,12 @@ def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
         )
         assert len(server._compiled) == 1
         assert out2["tokens"] != out["tokens"]  # seed actually varies output
+        # beam search route
+        beam = _post(
+            f"http://127.0.0.1:{port}/generate",
+            {"tokens": [[1, 2, 3]], "maxNewTokens": 5, "numBeams": 3},
+        )
+        assert len(beam["tokens"][0]) == 8
         # bad requests surface as 400 with a message, not a 500
         for bad in (
             {"tokens": []},
@@ -111,6 +117,7 @@ def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
             {"tokens": [[1, 2], [3]]},  # ragged
             {"tokens": [[1, 2, 3]], "maxNewTokens": 100},  # > seq_len
             {"tokens": [[999999]]},  # out of vocab
+            {"tokens": [[1, 2, 3]], "numBeams": 4096},  # beam DoS cap
         ):
             with pytest.raises(urllib.error.HTTPError) as err:
                 _post(f"http://127.0.0.1:{port}/generate", bad)
