@@ -29,7 +29,16 @@ def _load_docs(path: Union[str, Path]) -> list[dict]:
     p = Path(path)
     if not p.exists():
         raise PolyaxonfileError(f"polyaxonfile not found: {p}")
-    text = p.read_text()
+    try:
+        # explicit utf-8: the locale default (LANG=C containers) would
+        # reject valid UTF-8 polyaxonfiles with non-ASCII content
+        text = p.read_text(encoding="utf-8")
+    except UnicodeDecodeError as e:
+        raise PolyaxonfileError(
+            f"polyaxonfile {p} is not a text file (binary or non-UTF-8): {e}"
+        ) from e
+    except OSError as e:
+        raise PolyaxonfileError(f"polyaxonfile {p} is unreadable: {e}") from e
     try:
         if p.suffix == ".json":
             docs = [json.loads(text)]

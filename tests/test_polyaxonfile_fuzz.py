@@ -1,0 +1,105 @@
+"""Reader robustness fuzz: random structural mutations of a valid spec
+must surface as PolyaxonfileError (or validate), never any other
+exception type — the CLI maps PolyaxonfileError to a clean message, so
+anything else is a raw traceback in a user's face."""
+
+import copy
+import random
+
+import pytest
+import yaml
+
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
+
+BASE = {
+    "version": 1.1,
+    "kind": "operation",
+    "name": "fuzz-target",
+    "params": {"lr": {"value": 0.001}},
+    "component": {
+        "kind": "component",
+        "name": "fuzz-target",
+        "inputs": [{"name": "lr", "type": "float"}],
+        "termination": {"maxRetries": 1},
+        "run": {
+            "kind": "jaxjob",
+            "replicas": 2,
+            "mesh": {"data": 2},
+            "environment": {
+                "resources": {"tpu": {"type": "v5e", "topology": "2x4"}}
+            },
+            "program": {
+                "model": {"name": "mlp", "config": {"input_dim": 8}},
+                "data": {"name": "synthetic", "batchSize": 8},
+                "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                "train": {"steps": 2},
+            },
+        },
+    },
+}
+
+JUNK = [
+    None, -1, 0, 3.5, "", "garbage", "{{ params.missing }}", [], {}, [1, 2],
+    {"unexpected": True}, "2x", "vNaN", True, "  ", {"kind": "frobnicate"},
+]
+
+
+def _paths(node, prefix=()):
+    """Every (path, container, key) location in the nested spec."""
+    out = []
+    if isinstance(node, dict):
+        for k, v in node.items():
+            out.append((prefix + (k,), node, k))
+            out.extend(_paths(v, prefix + (k,)))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            out.append((prefix + (i,), node, i))
+            out.extend(_paths(v, prefix + (i,)))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_mutations_fail_cleanly(tmp_path, seed):
+    rng = random.Random(seed)
+    for trial in range(40):
+        spec = copy.deepcopy(BASE)
+        for _ in range(rng.randint(1, 3)):
+            # recompute per mutation: an earlier mutation may have detached
+            # the subtree a stale location pointed into
+            locations = _paths(spec)
+            _, container, key = rng.choice(locations)
+            action = rng.random()
+            if action < 0.5:
+                container[key] = rng.choice(JUNK)
+            elif action < 0.8 and isinstance(container, dict):
+                container.pop(key, None)
+            elif isinstance(container, dict):
+                container[f"fuzz_{rng.randint(0, 9)}"] = rng.choice(JUNK)
+        p = tmp_path / f"fuzz_{seed}_{trial}.yaml"
+        p.write_text(yaml.safe_dump(spec))
+        try:
+            read_polyaxonfile(str(p))
+        except PolyaxonfileError:
+            pass  # the designed failure mode
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            raise AssertionError(
+                f"mutation leaked {type(e).__name__} instead of "
+                f"PolyaxonfileError (seed={seed}, trial={trial}):\n"
+                f"{yaml.safe_dump(spec)}\n{e}"
+            ) from e
+
+
+def test_binary_and_deep_nesting_fail_cleanly(tmp_path):
+    cases = {
+        "binary.yaml": b"\x00\x01\x02\xff\xfe polyaxon",
+        "deep.yaml": ("[" * 150 + "]" * 150).encode(),
+        "empty.yaml": b"",
+        "scalar.yaml": b"42",
+        "anchor_bomb.yaml": b"a: &a [1]\nb: [*a, *a, *a]\nkind: operation",
+    }
+    for name, payload in cases.items():
+        p = tmp_path / name
+        p.write_bytes(payload)
+        with pytest.raises(PolyaxonfileError):
+            read_polyaxonfile(str(p))
