@@ -1,0 +1,106 @@
+"""Decode throughput: KV-cache generation tokens/sec on the current device.
+
+Measures the serving-side half of the framework (models/generate.py):
+prefill latency and steady-state decode tok/s for a chip-sized LM, plus
+beam-search overhead. Prints one JSON line per config.
+
+  python benchmarks/decode_bench.py            # default sweep
+  POLYAXON_JAX_PLATFORM=cpu python benchmarks/decode_bench.py  # smoke
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    from polyaxon_tpu.utils.jax_platform import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import build_model
+    from polyaxon_tpu.models.generate import beam_search, generate
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
+        cfg = {
+            "dim": 2048, "n_layers": 8, "n_heads": 16, "n_kv_heads": 16,
+            "vocab_size": 32768, "seq_len": 2048,
+        }
+        batch, prompt_len, max_new = 8, 512, 256
+    else:
+        cfg = {
+            "dim": 128, "n_layers": 2, "n_heads": 4, "n_kv_heads": 4,
+            "vocab_size": 1024, "seq_len": 256,
+        }
+        batch, prompt_len, max_new = 2, 32, 16
+
+    bundle = build_model("transformer_lm", cfg)
+    rng = jax.random.PRNGKey(0)
+    params = bundle.module.init(
+        {"params": rng}, jnp.zeros((batch, 8), jnp.int32), train=False
+    )["params"]
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+    prompt = jax.random.randint(
+        rng, (batch, prompt_len), 0, cfg["vocab_size"], dtype=jnp.int32
+    )
+
+    def timed(fn, *args, reps=3):
+        out = fn(*args)  # compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    g = jax.jit(
+        lambda p, pr, s: generate(
+            bundle.module, p, pr, max_new_tokens=max_new,
+            temperature=0.8, top_k=40, seed=s,
+        )
+    )
+    dt = timed(g, params, prompt, jnp.asarray(0, jnp.int32))
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec",
+        "value": round(batch * max_new / dt, 1),
+        "unit": "tok/s",
+        "device_kind": device.device_kind,
+        "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
+        "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
+        "per_token_ms": round(dt / max_new * 1e3, 3),
+    }), flush=True)
+
+    nb = 4
+    b = jax.jit(
+        lambda p, pr, s: beam_search(
+            bundle.module, p, pr, max_new_tokens=max_new, num_beams=nb,
+        )
+    )
+    dtb = timed(b, params, prompt, jnp.asarray(0, jnp.int32))
+    print(json.dumps({
+        "metric": "beam4_decode_tokens_per_sec",
+        "value": round(batch * max_new / dtb, 1),
+        "unit": "tok/s",
+        "device_kind": device.device_kind,
+        "beams": nb,
+        "vs_sampling": round(dt / dtb, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -254,6 +254,66 @@ def ops_metrics(uid):
         click.echo(json.dumps(m))
 
 
+@ops.command("compare")
+@click.option("-uid", "--uid", "uids", multiple=True, required=True,
+              help="repeat for each run (2+)")
+def ops_compare(uids):
+    """Side-by-side final metrics and params of two or more runs."""
+    if len(uids) < 2:
+        raise click.ClickException("compare needs at least two --uid")
+    client = _run_client()
+    if client._http is not None:
+        click.echo(
+            "note: params unavailable over the remote control plane "
+            "(metrics only)", err=True
+        )
+    cols = []
+    for uid in uids:
+        status = client.get(uid)
+        # fold last-value-per-key across ALL metric lines: system monitors
+        # interleave sys.* samples into the same stream, so the final line
+        # alone often carries no training metrics at all
+        folded: dict = {}
+        step = None
+        for rec in client.metrics(uid):
+            for k, v in rec.items():
+                if k == "step":
+                    step = max(step or 0, int(v)) if v is not None else step
+                elif k != "ts":
+                    folded[k] = v
+        spec = {}
+        if client._http is None:
+            spec = client.store.read_spec(client.store.resolve(uid)) or {}
+        cols.append({
+            "uid": status.get("uuid", uid)[:8],
+            "status": str(status.get("status", "?")),
+            "params": spec.get("params") or {},
+            "metrics": folded,
+            "step": step,
+        })
+    rows = sorted({k for c in cols for k in c["metrics"]})
+    pkeys = sorted({k for c in cols for k in c["params"]})
+    header = ["", *[c["uid"] for c in cols]]
+    table = [header, ["status", *[c["status"] for c in cols]],
+             ["step", *["—" if c["step"] is None else str(c["step"])
+                        for c in cols]]]
+    for k in pkeys:
+        table.append(
+            [f"param.{k}", *[str(c["params"].get(k, "—")) for c in cols]]
+        )
+    for k in rows:
+        table.append([
+            k,
+            *[
+                f"{c['metrics'][k]:.6g}" if k in c["metrics"] else "—"
+                for c in cols
+            ],
+        ])
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    for r in table:
+        click.echo("  ".join(x.ljust(w) for x, w in zip(r, widths)))
+
+
 @ops.command("artifacts")
 @click.option("-uid", "--uid", required=True)
 @click.option("--path", default=None, help="artifact path to download (omit to list)")

@@ -232,6 +232,30 @@ class TestCli:
         # mesh -1 resolved against the 2x4 tpu slice
         assert spec["component"]["run"]["mesh"] == {"data": 8}
 
+    def test_ops_compare(self, tmp_home):
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        runner = CliRunner()
+        uids = []
+        for lr in ("0.001", "0.01"):
+            res = runner.invoke(
+                cli,
+                ["run", "-f", "examples/mnist.yaml", "-P", "steps=3",
+                 "-P", "batch_size=16", "-P", f"lr={lr}"],
+            )
+            assert res.exit_code == 0, res.output
+            uids.append(res.output.split("run ")[1][:8])
+        res = runner.invoke(
+            cli, ["ops", "compare", "--uid", uids[0], "--uid", uids[1]]
+        )
+        assert res.exit_code == 0, res.output
+        assert "param.lr" in res.output and "loss" in res.output
+        assert "0.001" in res.output and "0.01" in res.output
+        res = runner.invoke(cli, ["ops", "compare", "--uid", uids[0]])
+        assert res.exit_code != 0 and "at least two" in res.output
+
 
 def test_grad_accum_matches_full_batch(tmp_home):
     """gradAccum=4 over a batch of 32 must take the same first optimizer
