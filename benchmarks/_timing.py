@@ -1,0 +1,20 @@
+"""Shared timing helper for the benchmark scripts (one methodology:
+warmup call excluded, mean over iters, device-synced)."""
+
+from __future__ import annotations
+
+import time
+
+
+def time_call(fn, *args, iters: int = 20) -> float:
+    """Mean wall time per call over `iters` calls; one warmup call runs
+    first so compile time is excluded."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters

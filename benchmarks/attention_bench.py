@@ -16,25 +16,13 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 from functools import partial
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def _time_call(fn, *args, iters: int = 20) -> float:
-    """Mean wall time per call over `iters` calls, compile excluded (one
-    warmup call runs first)."""
-    import jax
-
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+from _timing import time_call as _time_call  # noqa: E402 — shared methodology
 
 
 def main():

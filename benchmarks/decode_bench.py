@@ -59,39 +59,44 @@ def main():
         rng, (batch, prompt_len), 0, cfg["vocab_size"], dtype=jnp.int32
     )
 
-    def timed(fn, *args, reps=3):
-        out = fn(*args)  # compile
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / reps
+    from _timing import time_call
 
-    g = jax.jit(
-        lambda p, pr, s: generate(
-            bundle.module, p, pr, max_new_tokens=max_new,
-            temperature=0.8, top_k=40, seed=s,
+    def timed(fn, *args):
+        return time_call(fn, *args, iters=3)
+
+    def gen_fn(n):
+        return jax.jit(
+            lambda p, pr, s: generate(
+                bundle.module, p, pr, max_new_tokens=n,
+                temperature=0.8, top_k=40, seed=s,
+            )
         )
-    )
-    dt = timed(g, params, prompt, jnp.asarray(0, jnp.int32))
+
+    seed = jnp.asarray(0, jnp.int32)
+    # prefill cost = a 1-new-token generation; steady-state decode is the
+    # marginal cost of the remaining max_new-1 tokens
+    dt_prefill = timed(gen_fn(1), params, prompt, seed)
+    dt = timed(gen_fn(max_new), params, prompt, seed)
+    decode_dt = max(dt - dt_prefill, 1e-9)
     print(json.dumps({
         "metric": "decode_tokens_per_sec",
-        "value": round(batch * max_new / dt, 1),
+        "value": round(batch * (max_new - 1) / decode_dt, 1),
         "unit": "tok/s",
         "device_kind": device.device_kind,
         "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
         "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
-        "per_token_ms": round(dt / max_new * 1e3, 3),
+        "prefill_ms": round(dt_prefill * 1e3, 2),
+        "per_token_ms": round(decode_dt / (max_new - 1) * 1e3, 3),
+        "end_to_end_s": round(dt, 3),
     }), flush=True)
 
     nb = 4
     b = jax.jit(
-        lambda p, pr, s: beam_search(
+        lambda p, pr: beam_search(
             bundle.module, p, pr, max_new_tokens=max_new, num_beams=nb,
         )
     )
-    dtb = timed(b, params, prompt, jnp.asarray(0, jnp.int32))
+    dtb = timed(b, params, prompt)
     print(json.dumps({
         "metric": "beam4_decode_tokens_per_sec",
         "value": round(batch * max_new / dtb, 1),

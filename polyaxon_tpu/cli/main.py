@@ -262,28 +262,28 @@ def ops_compare(uids):
     if len(uids) < 2:
         raise click.ClickException("compare needs at least two --uid")
     client = _run_client()
-    if client._http is not None:
-        click.echo(
-            "note: params unavailable over the remote control plane "
-            "(metrics only)", err=True
-        )
     cols = []
     for uid in uids:
         status = client.get(uid)
         # fold last-value-per-key across ALL metric lines: system monitors
         # interleave sys.* samples into the same stream, so the final line
-        # alone often carries no training metrics at all
+        # alone often carries no training metrics at all. The step column
+        # folds only from TRAINING records (ones carrying a non-sys metric)
+        # — monitor records use their own sample counter as `step`.
         folded: dict = {}
         step = None
         for rec in client.metrics(uid):
+            is_training = any(
+                k not in ("step", "ts") and not k.startswith("sys.")
+                for k in rec
+            )
             for k, v in rec.items():
                 if k == "step":
-                    step = max(step or 0, int(v)) if v is not None else step
+                    if is_training and v is not None:
+                        step = max(step or 0, int(v))
                 elif k != "ts":
                     folded[k] = v
-        spec = {}
-        if client._http is None:
-            spec = client.store.read_spec(client.store.resolve(uid)) or {}
+        spec = client.spec(uid)
         cols.append({
             "uid": status.get("uuid", uid)[:8],
             "status": str(status.get("status", "?")),

@@ -272,6 +272,14 @@ class RunClient:
             return self._http.get(f"/runs/{uuid}/events")
         return self.store.read_events(uuid)
 
+    def spec(self, uuid: str) -> dict:
+        """The run's resolved (compiled) spec — served remotely at
+        GET /runs/<uuid>/spec."""
+        uuid = self._resolve(uuid)
+        if self._http:
+            return self._http.get(f"/runs/{uuid}/spec") or {}
+        return self.store.read_spec(uuid) or {}
+
     def artifacts(self, uuid: str) -> list[str]:
         uuid = self._resolve(uuid)
         if self._http:

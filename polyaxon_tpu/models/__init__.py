@@ -13,3 +13,4 @@ from . import seq2seq  # noqa: F401
 from . import transformer  # noqa: F401
 from . import vit  # noqa: F401
 from .generate import beam_search, generate  # noqa: F401,E402 — decode-side public API
+from .convert_hf import from_hf_llama  # noqa: F401,E402 — HF checkpoint import

@@ -1,0 +1,104 @@
+"""HuggingFace Llama checkpoint import: HF weights → this framework.
+
+A user of the reference brings their models from the HF hub; this maps a
+`transformers` Llama-family state dict onto `models/transformer.py`'s
+param tree (same architecture: RMSNorm + RoPE + GQA + SwiGLU; HF's
+`rotate_half` convention equals our first/second-half rope pairs, so
+logits match to float tolerance — asserted in tests/test_convert_hf.py).
+
+    from transformers import LlamaForCausalLM
+    from polyaxon_tpu.models.convert_hf import from_hf_llama
+
+    cfg, params = from_hf_llama(LlamaForCausalLM.from_pretrained(path))
+    bundle = build_model("transformer_lm", cfg)
+    tokens = generate(bundle.module, params, prompt, max_new_tokens=64)
+
+Torch weight layout is [out, in]; flax Dense kernels are [in, out], so
+every projection transposes. Only the Llama family is supported (the
+fields read off the HF config are Llama's); Mistral/Qwen-style variants
+with identical block structure also pass through.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class HFConversionError(ValueError):
+    pass
+
+
+def _np(t):
+    import numpy as np
+
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def from_hf_llama(hf_model) -> tuple[dict[str, Any], dict]:
+    """(model_config, params) from a transformers Llama-family model.
+
+    `model_config` feeds `build_model("transformer_lm", model_config)`;
+    `params` is the matching flax param tree (float32 — cast to bf16 for
+    serving if wanted)."""
+    hf_cfg = hf_model.config
+    sd = hf_model.state_dict()
+
+    dim = int(hf_cfg.hidden_size)
+    n_heads = int(hf_cfg.num_attention_heads)
+    n_kv = int(getattr(hf_cfg, "num_key_value_heads", n_heads))
+    head_dim = int(getattr(hf_cfg, "head_dim", None) or dim // n_heads)
+    if head_dim * n_heads != dim:
+        raise HFConversionError(
+            f"unsupported geometry: head_dim {head_dim} x n_heads {n_heads} "
+            f"!= hidden_size {dim} (this framework derives head_dim from dim)"
+        )
+    tie = bool(getattr(hf_cfg, "tie_word_embeddings", False))
+    cfg = {
+        "dim": dim,
+        "n_layers": int(hf_cfg.num_hidden_layers),
+        "n_heads": n_heads,
+        "n_kv_heads": n_kv,
+        "hidden_dim": int(hf_cfg.intermediate_size),
+        "vocab_size": int(hf_cfg.vocab_size),
+        "seq_len": int(hf_cfg.max_position_embeddings),
+        "rope_theta": float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        "norm_eps": float(hf_cfg.rms_norm_eps),
+        "tie_embeddings": tie,
+    }
+
+    def take(key):
+        if key not in sd:
+            raise HFConversionError(
+                f"state dict has no {key!r} — not a Llama-family checkpoint? "
+                f"(keys look like: {sorted(sd)[:3]} …)"
+            )
+        return _np(sd[key])
+
+    params: dict[str, Any] = {
+        "embed": {"embedding": take("model.embed_tokens.weight")},
+        "final_norm": {"scale": take("model.norm.weight")},
+    }
+    if not tie:
+        params["lm_head"] = {"kernel": take("lm_head.weight").T}
+    for i in range(cfg["n_layers"]):
+        pre = f"model.layers.{i}"
+        params[f"layer_{i}"] = {
+            "attention_norm": {"scale": take(f"{pre}.input_layernorm.weight")},
+            "mlp_norm": {
+                "scale": take(f"{pre}.post_attention_layernorm.weight")
+            },
+            "attention": {
+                "q_proj": {"kernel": take(f"{pre}.self_attn.q_proj.weight").T},
+                "k_proj": {"kernel": take(f"{pre}.self_attn.k_proj.weight").T},
+                "v_proj": {"kernel": take(f"{pre}.self_attn.v_proj.weight").T},
+                "o_proj": {"kernel": take(f"{pre}.self_attn.o_proj.weight").T},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": take(f"{pre}.mlp.gate_proj.weight").T},
+                "up_proj": {"kernel": take(f"{pre}.mlp.up_proj.weight").T},
+                "down_proj": {"kernel": take(f"{pre}.mlp.down_proj.weight").T},
+            },
+        }
+    return cfg, params
