@@ -114,6 +114,8 @@ def test_http_write_side_end_to_end(tmp_home, tmp_path):
         t.join()
         assert status == V1Statuses.SUCCEEDED
         assert "out-line" in remote.logs(uuid)
+        # resolved spec over the wire (ops compare reads params from it)
+        assert remote.spec(uuid).get("runUuid") == uuid
 
         # stop a queued run remotely; the agent must then skip it
         uuid2 = remote.create(_op(tmp_path))

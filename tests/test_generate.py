@@ -76,6 +76,7 @@ def test_eos_freezes_finished_rows():
             assert (gen[hits[0]:] == eos).all()
 
 
+@pytest.mark.slow
 def test_eos_in_prompt_does_not_freeze_generation():
     """Prompts legitimately contain eos as separators (chat templates,
     packed documents); only a GENERATED eos may finish a row."""

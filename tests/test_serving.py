@@ -104,12 +104,6 @@ def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
         )
         assert len(server._compiled) == 1
         assert out2["tokens"] != out["tokens"]  # seed actually varies output
-        # beam search route
-        beam = _post(
-            f"http://127.0.0.1:{port}/generate",
-            {"tokens": [[1, 2, 3]], "maxNewTokens": 5, "numBeams": 3},
-        )
-        assert len(beam["tokens"][0]) == 8
         # bad requests surface as 400 with a message, not a 500
         for bad in (
             {"tokens": []},
@@ -122,6 +116,24 @@ def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
             with pytest.raises(urllib.error.HTTPError) as err:
                 _post(f"http://127.0.0.1:{port}/generate", bad)
             assert err.value.code == 400, bad
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_beam_route_over_http(tmp_home, tmp_path):
+    from polyaxon_tpu.runtime.checkpoint import close_all
+
+    store, uuid = _train_run(tmp_path)
+    close_all()
+    server = ModelServer.from_run(uuid, store=store)
+    port = server.start(port=0)
+    try:
+        beam = _post(
+            f"http://127.0.0.1:{port}/generate",
+            {"tokens": [[1, 2, 3]], "maxNewTokens": 5, "numBeams": 3},
+        )
+        assert len(beam["tokens"][0]) == 8
     finally:
         server.stop()
 
