@@ -46,7 +46,9 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dropout_rate: float = 0.0
-    attention: str = "xla"  # xla | flash | ring | ulysses
+    # auto = flash on TPU past ~2k tokens (O(S^2) score matrix starts to
+    # dominate HBM traffic), xla otherwise; explicit values force a backend
+    attention: str = "auto"  # auto | xla | flash | ring | ulysses
     attention_block: int = 512  # kv block size for flash/ring backends
     lora_rank: int = 0
     lora_alpha: float = 16.0
