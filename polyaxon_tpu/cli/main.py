@@ -443,15 +443,30 @@ def agent_drain(queues):
 @click.option("-uid", "--uid", required=True, help="run to serve (uuid/prefix/name)")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", default=8601, type=int)
-def serve(uid, host, port):
+@click.option("--mesh", default=None,
+              help="shard params over a device mesh, e.g. model=4 or "
+                   "model=2,fsdp=2 — for models too big for one chip")
+def serve(uid, host, port, mesh):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, POST /generate)."""
     from ..serving import ModelServer
     from ..serving.server import ServingError
 
+    mesh_axes = None
+    if mesh:
+        try:
+            mesh_axes = {
+                k.strip(): int(v)
+                for k, v in (part.split("=", 1) for part in mesh.split(","))
+            }
+        except ValueError:
+            raise click.ClickException(
+                f"--mesh expects axis=N[,axis=N...], got {mesh!r}"
+            )
     try:
-        server = ModelServer.from_run(uid)
-    except (ServingError, KeyError) as e:
+        server = ModelServer.from_run(uid, mesh_axes=mesh_axes)
+    except (ServingError, KeyError, ValueError) as e:
+        # ValueError: mesh-vs-device/model mismatch from the mesh builder
         raise click.ClickException(str(e.args[0]) if e.args else str(e))
     bound = server.start(host=host, port=port)
     click.echo(

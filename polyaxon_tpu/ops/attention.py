@@ -16,30 +16,30 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def resolve_auto_backend(seq_len: int, head_dim: int, block_kv: int) -> str:
-    """`auto` policy: the Pallas flash kernel when the O(S^2) score matrix
-    starts to matter and the shapes satisfy the kernel's block layout; XLA
-    einsum otherwise.
+def resolve_auto_backend(seq_len: int, block_kv: int) -> str:
+    """`auto` policy: the Pallas flash kernel on a SINGLE TPU chip when
+    the O(S^2) score matrix starts to matter and the shapes satisfy the
+    kernel's block layout; the XLA einsum otherwise.
 
     Rationale: at short seq the einsum path is a single fused MXU pass and
     XLA's softmax fusion is hard to beat; past ~2k tokens the [B,H,S,S]
     f32 score matrix dominates HBM traffic and the blockwise kernel's
-    O(S) VMEM streaming wins (pallas_guide.md). Guards mirror
-    flash_attention's actual requirements: seq divisible by BOTH block
-    sizes (block_q is 128). Mesh-aware: a live context axis means the
-    sequence dim is sharded for ring/ulysses — the single-device pallas
-    kernel has no partitioning rule there, so auto stays on the
-    GSPMD-partitionable einsum."""
-    from ..parallel.ring import current_mesh
+    O(S) VMEM streaming wins (pallas_guide.md). Shape guards mirror
+    flash_attention's: seq divisible by BOTH block sizes (block_q is 128).
 
-    mesh = current_mesh()
-    if mesh is not None and mesh.shape.get("context", 1) > 1:
-        return "xla"
-    on_tpu = jax.default_backend() == "tpu"
+    Single-device only, by global device count: the pallas kernel has no
+    GSPMD partitioning rule, so under ANY live mesh (data/fsdp/model as
+    much as context) the partitionable einsum must win — and the device
+    count, unlike mesh context vars, is visible from every thread
+    (serving traces in HTTP handler threads). Multi-chip configs choose
+    `flash` inside shard_map paths, or `ring`/`ulysses`, explicitly."""
+    single_tpu = (
+        jax.default_backend() == "tpu" and len(jax.devices()) == 1
+    )
     block_q = 128  # flash_attention's default q block
     return (
         "flash"
-        if on_tpu
+        if single_tpu
         and seq_len >= 2048
         and seq_len % min(block_kv, seq_len) == 0
         and seq_len % min(block_q, seq_len) == 0
@@ -52,7 +52,7 @@ def dot_product_attention(
 ):
     """q/k/v: [B, S, H, D], equal head counts (expand GQA first) → [B, S, H, D]."""
     if backend == "auto":
-        backend = resolve_auto_backend(q.shape[1], q.shape[-1], block_kv)
+        backend = resolve_auto_backend(q.shape[1], block_kv)
     if backend == "flash":
         from .flash_attention import flash_attention
 

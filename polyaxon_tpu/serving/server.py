@@ -111,11 +111,20 @@ class ModelServer:
 
     # ------------------------------------------------------------ loading
     @classmethod
-    def from_run(cls, run_ref: str, store: Optional[RunStore] = None):
+    def from_run(
+        cls,
+        run_ref: str,
+        store: Optional[RunStore] = None,
+        mesh_axes: Optional[dict] = None,
+    ):
         """Restore the latest checkpoint of a `transformer_lm` jaxjob run.
 
         Rebuilds the trainer from the run's stored spec (same code path the
-        executor used), restores TrainState, and serves its params."""
+        executor used), restores TrainState, and serves its params.
+        `mesh_axes` (e.g. {"model": 4}) shards the restored params over a
+        device mesh for models too big for one chip — decode is unchanged,
+        XLA inserts the collectives from the param shardings (parity with
+        single-device decoding is tested)."""
         import jax
 
         from ..runtime.trainer import Trainer
@@ -144,7 +153,8 @@ class ModelServer:
             )
         trainer = Trainer(
             program,
-            devices=[jax.devices()[0]],
+            mesh_axes=mesh_axes,
+            devices=None if mesh_axes else [jax.devices()[0]],
             checkpoint_dir=str(ckpt_dir),
         )
         step = trainer.restore()

@@ -196,24 +196,17 @@ def test_trainer_ulysses_attention_end_to_end(tmp_home):
 
 
 def test_auto_backend_resolution():
-    """`auto` picks flash only on TPU with long, block-aligned shapes."""
+    """`auto` picks flash only on a SINGLE TPU chip with long,
+    block-aligned shapes — any multi-device environment (this suite's
+    8-CPU virtual slice included) stays on the partitionable einsum."""
     import jax
 
     from polyaxon_tpu.ops.attention import resolve_auto_backend
 
-    if jax.default_backend() == "tpu":  # pragma: no cover — chip-only
-        assert resolve_auto_backend(4096, 128, 512) == "flash"
-        assert resolve_auto_backend(1024, 128, 512) == "xla"  # short seq
-        assert resolve_auto_backend(2496, 128, 192) == "xla"  # % block_q
+    if jax.default_backend() == "tpu" and len(jax.devices()) == 1:
+        # pragma: no cover — chip-only branch
+        assert resolve_auto_backend(4096, 512) == "flash"
+        assert resolve_auto_backend(1024, 512) == "xla"  # short seq
+        assert resolve_auto_backend(2496, 192) == "xla"  # % block_q fails
     else:
-        assert resolve_auto_backend(4096, 128, 512) == "xla"  # not a TPU
-
-    # a live context axis always forces the partitionable einsum path
-    from polyaxon_tpu.parallel.mesh import build_mesh
-    from polyaxon_tpu.parallel.ring import set_current_mesh
-
-    set_current_mesh(build_mesh({"context": 2, "data": 4}))
-    try:
-        assert resolve_auto_backend(4096, 128, 512) == "xla"
-    finally:
-        set_current_mesh(None)
+        assert resolve_auto_backend(4096, 512) == "xla"

@@ -155,3 +155,32 @@ def test_from_run_errors(tmp_home, tmp_path):
     assert Executor(store, devices=jax.devices()[:1]).execute(compiled) == "succeeded"
     with pytest.raises(ServingError, match="checkpoint"):
         ModelServer.from_run(compiled.run_uuid, store=store)
+
+
+@pytest.mark.slow
+def test_mesh_sharded_serving_over_http(tmp_home, tmp_path):
+    """--mesh serving: params restored sharded over an 8-device mesh serve
+    the same greedy tokens as single-device serving."""
+    from polyaxon_tpu.runtime.checkpoint import close_all
+
+    store, uuid = _train_run(tmp_path)
+    close_all()
+    body = {"tokens": [[1, 2, 3]], "maxNewTokens": 5}
+
+    single = ModelServer.from_run(uuid, store=store)
+    port = single.start(port=0)
+    try:
+        ref = _post(f"http://127.0.0.1:{port}/generate", body)
+    finally:
+        single.stop()
+
+    close_all()
+    sharded = ModelServer.from_run(
+        uuid, store=store, mesh_axes={"data": 2, "model": 2, "fsdp": 2}
+    )
+    port = sharded.start(port=0)
+    try:
+        out = _post(f"http://127.0.0.1:{port}/generate", body)
+    finally:
+        sharded.stop()
+    assert out["tokens"] == ref["tokens"]
