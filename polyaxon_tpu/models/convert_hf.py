@@ -102,3 +102,39 @@ def from_hf_llama(hf_model) -> tuple[dict[str, Any], dict]:
             },
         }
     return cfg, params
+
+
+def to_hf_llama_state_dict(cfg: dict, params) -> dict:
+    """Inverse of from_hf_llama: the framework's (config, params) → an HF
+    Llama state dict (numpy float32 arrays, torch [out, in] layout). Load
+    it with `hf_model.load_state_dict({k: torch.tensor(v) ...})` — the
+    fine-tune-here, publish-to-HF half of the interop story."""
+    import numpy as np
+
+    def arr(x):
+        return np.asarray(x, dtype=np.float32)
+
+    sd: dict = {
+        "model.embed_tokens.weight": arr(params["embed"]["embedding"]),
+        "model.norm.weight": arr(params["final_norm"]["scale"]),
+    }
+    if not cfg.get("tie_embeddings"):
+        sd["lm_head.weight"] = arr(params["lm_head"]["kernel"]).T
+    for i in range(int(cfg["n_layers"])):
+        layer = params[f"layer_{i}"]
+        pre = f"model.layers.{i}"
+        sd[f"{pre}.input_layernorm.weight"] = arr(
+            layer["attention_norm"]["scale"]
+        )
+        sd[f"{pre}.post_attention_layernorm.weight"] = arr(
+            layer["mlp_norm"]["scale"]
+        )
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[f"{pre}.self_attn.{name}.weight"] = arr(
+                layer["attention"][name]["kernel"]
+            ).T
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[f"{pre}.mlp.{name}.weight"] = arr(
+                layer["mlp"][name]["kernel"]
+            ).T
+    return sd
