@@ -14,4 +14,4 @@ from . import transformer  # noqa: F401
 from . import vit  # noqa: F401
 from .generate import beam_search, generate  # noqa: F401,E402 — decode-side public API
 from .convert_hf import from_hf_llama  # noqa: F401,E402 — HF checkpoint import
-from .convert_hf import to_hf_llama_state_dict  # noqa: F401,E402
+from .convert_hf import merge_lora, to_hf_llama_state_dict  # noqa: F401,E402

@@ -104,6 +104,32 @@ def from_hf_llama(hf_model) -> tuple[dict[str, Any], dict]:
     return cfg, params
 
 
+def merge_lora(params, *, alpha: float = 16.0) -> dict:
+    """Fold LoRA deltas into their base kernels: every projection with
+    `lora_a`/`lora_b` becomes a plain kernel `W + (alpha/r)(A @ B)` and the
+    LoRA leaves are dropped. The merged tree loads into a `lora_rank: 0`
+    model (and from there exports to HF via to_hf_llama_state_dict) —
+    the publish step of the Llama-LoRA fine-tuning workflow.
+
+    `alpha` must match the training config's lora_alpha; the rank is read
+    off the `lora_a` shape."""
+    import numpy as np
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if "lora_a" in node and "lora_b" in node and "kernel" in node:
+            a = np.asarray(node["lora_a"], np.float32)
+            b = np.asarray(node["lora_b"], np.float32)
+            w = np.asarray(node["kernel"], np.float32)
+            rank = a.shape[1]
+            merged = w + (float(alpha) / rank) * (a @ b)
+            return {"kernel": merged.astype(np.asarray(node["kernel"]).dtype)}
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)
+
+
 def to_hf_llama_state_dict(cfg: dict, params) -> dict:
     """Inverse of from_hf_llama: the framework's (config, params) → an HF
     Llama state dict (numpy float32 arrays, torch [out, in] layout). Load
