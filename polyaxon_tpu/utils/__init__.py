@@ -1,0 +1,5 @@
+"""Shared utilities: jax platform selection that survives the axon TPU
+plugin (jax_platform) and TPU hardware metadata (tpu_info)."""
+
+from .jax_platform import apply_platform, apply_platform_env  # noqa: F401
+from .tpu_info import peak_bf16_flops  # noqa: F401
