@@ -243,3 +243,61 @@ def test_reconciler_queue_scoping(tmp_home, tmp_path):
     assert store.get_status(uuids["b"])["status"] == V1Statuses.SCHEDULED
     rec_b = Reconciler(store, cluster, queues=["b"])
     assert dict(rec_b.tick()) == {uuids["b"]: V1Statuses.SUCCEEDED}
+
+
+def test_two_scoped_agents_share_a_store(tmp_home, tmp_path):
+    """Two serve() agents with disjoint --queue filters on one store: each
+    reconciles only its own gang to completion; neither double-drives the
+    other's runs (the cluster sees exactly one submit per run)."""
+    import threading
+    import time as _time
+
+    store, cluster = RunStore(), FakeCluster()
+    submit = ClusterSubmitter(store, cluster, ConnectionCatalog())
+    front = Agent(store=store, submit_fn=submit)  # enqueue-only frontend
+    uuids = {}
+    for qname in ("qa", "qb"):
+        spec = dict(SPEC, queue=qname, name=f"svc-{qname}")
+        p = tmp_path / f"{qname}.yaml"
+        p.write_text(yaml.safe_dump(spec))
+        uuids[qname] = front.submit(read_polyaxonfile(str(p)))
+
+    hard_stop = _time.time() + 45
+
+    def _done():
+        return _time.time() > hard_stop or all(
+            store.get_status(u).get("status") in ("succeeded", "failed")
+            for u in uuids.values()
+        )
+
+    agents = [
+        Agent(store=store, submit_fn=submit, queues=[q]) for q in ("qa", "qb")
+    ]
+    threads = [
+        threading.Thread(
+            target=lambda a=a: a.serve(poll_interval=0.05, stop_when=_done),
+            daemon=True,
+        )
+        for a in agents
+    ]
+    for t in threads:
+        t.start()
+    deadline = _time.time() + 20
+    while (
+        not all(u in cluster.pods for u in uuids.values())
+        and _time.time() < deadline
+    ):
+        _time.sleep(0.05)
+    for u in uuids.values():
+        cluster.set_all(u, "Running")
+    _time.sleep(0.3)
+    for u in uuids.values():
+        cluster.set_all(u, "Succeeded")
+    for t in threads:
+        t.join(timeout=20)
+    for q, u in uuids.items():
+        assert store.get_status(u)["status"] == V1Statuses.SUCCEEDED, q
+    # exactly one submit per run: no agent re-submitted the other's gang
+    submits = [u for u in cluster.submitted]
+    assert sorted(submits) == sorted(uuids.values())
+    assert cluster.deleted == []  # no spurious teardown either
