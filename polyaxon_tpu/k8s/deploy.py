@@ -9,7 +9,6 @@ access is assumed from this environment)."""
 
 from __future__ import annotations
 
-from typing import Optional
 
 DEFAULT_IMAGE = "polyaxon-tpu/cli:latest"
 
