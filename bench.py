@@ -57,7 +57,9 @@ def _acquire_device(retries: int = 4):
 
 def _model_cfg(on_tpu: bool) -> tuple[dict, int, int, int]:
     """(model_cfg, batch, seq, steps) — chip-sized on TPU (MXU-bound),
-    tiny on CPU (the fallback only proves the pipeline runs)."""
+    tiny on CPU (the fallback only proves the pipeline runs). The TPU
+    batch is the LARGEST candidate; run_bench walks down on OOM (bigger
+    batches amortize the optimizer/elementwise work → higher MFU)."""
     if on_tpu:
         cfg = {
             "dim": 2048,
@@ -67,7 +69,7 @@ def _model_cfg(on_tpu: bool) -> tuple[dict, int, int, int]:
             "vocab_size": 32768,
             "seq_len": 1024,
         }
-        return cfg, 8, 1024, 30
+        return cfg, 16, 1024, 30
     cfg = {
         "dim": 256,
         "n_layers": 4,
@@ -179,7 +181,37 @@ def _phase(msg: str):
     print(f"bench [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+def _is_oom(e: Exception) -> bool:
+    """True only for genuine device-memory exhaustion — a transient gRPC
+    RESOURCE_EXHAUSTED from the flaky tunnel must NOT silently halve the
+    benchmark batch."""
+    msg = str(e).lower()
+    return "out of memory" in msg or (
+        "resource_exhausted" in msg and "alloc" in msg
+    )
+
+
+def _walk_down(label: str, batch: int, fn, floor: int = 4):
+    """(batch, fn(batch)) at the largest batch <= `batch` that fits in
+    HBM, halving on OOM down to `floor` — bigger batches amortize the
+    optimizer/elementwise work (higher MFU), and headroom varies across
+    runtime versions, so the first choice is optimistic by design."""
+    import gc
+
+    while True:
+        try:
+            return batch, fn(batch)
+        except Exception as e:  # noqa: BLE001 — OOM walk-down only
+            if not (_is_oom(e) and batch > floor):
+                raise
+            _phase(f"{label}: batch {batch} OOM; retrying at {batch // 2}")
+            gc.collect()
+            batch //= 2
+
+
 def run_bench() -> dict:
+    import gc
+
     device = _acquire_device()
     on_tpu = device.platform == "tpu"
     model_cfg, batch, seq, steps = _model_cfg(on_tpu)
@@ -191,33 +223,47 @@ def run_bench() -> dict:
     # including metric logging and history bookkeeping. Pinned to ONE device
     # (like the bare baseline) so vs_baseline measures framework overhead,
     # not device count; single-chip MFU is the judged perf metric.
-    trainer = Trainer(_program(model_cfg, steps, batch, seq), devices=[device])
-    _phase("trainer built (params materialized)")
-    trainer.run()  # first run pays compile; timing comes from a rerun
-    _phase("warmup run done (step compiled)")
-    t0 = time.perf_counter()
-    trainer.run()
-    dt = time.perf_counter() - t0
-    framework_tps = steps * batch * seq / dt
-    _phase(f"framework timed run done: {framework_tps:,.0f} tok/s")
+    def build_and_warm(b):
+        t = Trainer(_program(model_cfg, steps, b, seq), devices=[device])
+        _phase(f"trainer built (params materialized, batch={b})")
+        t.run()  # first run pays compile; timing comes from a rerun
+        return t
 
-    flops_per_step = _step_flops(trainer)
-    peak = _peak_flops(device.device_kind)
-    mfu = None
-    if flops_per_step and peak:
-        mfu = round(flops_per_step * (steps / dt) / peak, 4)
+    while True:
+        batch, trainer = _walk_down("trainer", batch, build_and_warm)
+        _phase("warmup run done (step compiled)")
+        t0 = time.perf_counter()
+        trainer.run()
+        dt = time.perf_counter() - t0
+        framework_tps = steps * batch * seq / dt
+        _phase(f"framework timed run done: {framework_tps:,.0f} tok/s")
 
-    # Free the trainer's device state (params + adam moments, ~6GB at
-    # dim 2048) before the bare loop materializes its own full copy —
-    # both resident at once exhausts a v5e chip's HBM.
-    del trainer
-    import gc
+        flops_per_step = _step_flops(trainer)
+        peak = _peak_flops(device.device_kind)
+        mfu = None
+        if flops_per_step and peak:
+            mfu = round(flops_per_step * (steps / dt) / peak, 4)
 
-    gc.collect()
-    _phase("trainer state freed")
+        # Free the trainer's device state (params + adam moments, ~6GB at
+        # dim 2048) before the bare loop materializes its own full copy —
+        # both resident at once exhausts a v5e chip's HBM.
+        del trainer
+        gc.collect()
+        _phase("trainer state freed")
 
-    bare_tps = _bare_tokens_per_sec(model_cfg, batch, seq, steps)
-    _phase(f"bare-JAX baseline done: {bare_tps:,.0f} tok/s")
+        bare_batch, bare_tps = _walk_down(
+            "bare loop",
+            batch,
+            lambda b: _bare_tokens_per_sec(model_cfg, b, seq, steps),
+        )
+        _phase(f"bare-JAX baseline done: {bare_tps:,.0f} tok/s (batch={bare_batch})")
+        if bare_batch == batch:
+            break
+        # vs_baseline must compare EQUAL batches (tok/s varies with batch)
+        # — redo the framework at the batch the bare loop fit. Terminates:
+        # batch strictly decreases toward the floor.
+        _phase(f"re-running framework at the shared batch {bare_batch}")
+        batch = bare_batch
 
     return {
         "metric": "transformer_tokens_per_sec",
@@ -296,7 +342,7 @@ def main():
         _child_main()
         return
 
-    deadline = float(os.environ.get("POLYAXON_BENCH_TIMEOUT", "900"))
+    deadline = float(os.environ.get("POLYAXON_BENCH_TIMEOUT", "1500"))
     t_start = time.monotonic()
     # probe shares the overall budget: never exceed POLYAXON_BENCH_TIMEOUT
     probe_s = min(
