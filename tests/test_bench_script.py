@@ -1,0 +1,61 @@
+"""The driver-facing evidence scripts must always emit parseable output:
+bench.py one JSON line with the contract fields, decode/attention benches
+one JSON object per config. These are the round's scorecard inputs — a
+regression here silently voids the perf evidence."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.slow  # each drives a real (small) training loop
+
+
+def _run(script, env_extra, timeout=420):
+    import os
+
+    env = dict(
+        os.environ,
+        POLYAXON_JAX_PLATFORM="cpu",
+        POLYAXON_NUM_CPU_DEVICES="1",
+        **env_extra,
+    )
+    return subprocess.run(
+        [sys.executable, str(REPO / script)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_bench_emits_contract_line(tmp_home):
+    proc = _run("bench.py", {"POLYAXON_BENCH_TIMEOUT": "360"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    assert lines, proc.stdout
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "transformer_tokens_per_sec"
+    assert rec["unit"] == "tok/s"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+    assert "device_kind" in rec and "bare_tokens_per_sec" in rec
+
+
+def test_decode_bench_emits_json(tmp_home):
+    proc = _run("benchmarks/decode_bench.py", {})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [
+        json.loads(l)
+        for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    ]
+    metrics = {r["metric"] for r in recs}
+    assert "decode_tokens_per_sec" in metrics
+    assert "beam4_decode_tokens_per_sec" in metrics
+    for r in recs:
+        assert r["value"] > 0, r
