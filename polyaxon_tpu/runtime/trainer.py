@@ -431,9 +431,15 @@ class Trainer:
                 inputs = inputs.astype(compute_dtype)
             variables = {"params": params, **state.extra}
             logits = bundle.module.apply(variables, inputs, train=False)
-            metrics = {"eval.loss": loss_fn(logits, batch).astype(jnp.float32)}
+            loss = loss_fn(logits, batch).astype(jnp.float32)
+            metrics = {"eval.loss": loss}
             if is_classification:
                 metrics["eval.accuracy"] = accuracy_metric(logits, batch)
+            # cross-entropy family (LM/MLM/seq2seq): loss is mean nats per
+            # token, so perplexity is well-defined
+            loss_name = self.tspec.loss or self.bundle.loss
+            if "cross_entropy" in loss_name or loss_name == "masked_lm":
+                metrics["eval.perplexity"] = jnp.exp(loss)
             return metrics
 
         self.eval_step = jax.jit(
