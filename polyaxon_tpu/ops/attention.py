@@ -16,10 +16,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def resolve_auto_backend(seq_len: int, block_kv: int) -> str:
-    """`auto` policy: the Pallas flash kernel on a SINGLE TPU chip when
-    the O(S^2) score matrix starts to matter and the shapes satisfy the
-    kernel's block layout; the XLA einsum otherwise.
+def resolve_auto_backend(
+    seq_len: int, block_kv: int, head_dim: int | None = None
+) -> str:
+    """`auto` policy: the Pallas flash kernel on TPU when the O(S^2) score
+    matrix starts to matter and the shapes satisfy the kernel's block
+    layout; the XLA einsum otherwise.
 
     Rationale: at short seq the einsum path is a single fused MXU pass and
     XLA's softmax fusion is hard to beat; past ~2k tokens the [B,H,S,S]
@@ -27,24 +29,78 @@ def resolve_auto_backend(seq_len: int, block_kv: int) -> str:
     O(S) VMEM streaming wins (pallas_guide.md). Shape guards mirror
     flash_attention's: seq divisible by BOTH block sizes (block_q is 128).
 
-    Single-device only, by global device count: the pallas kernel has no
-    GSPMD partitioning rule, so under ANY live mesh (data/fsdp/model as
-    much as context) the partitionable einsum must win — and the device
-    count, unlike mesh context vars, is visible from every thread
-    (serving traces in HTTP handler threads). Multi-chip configs choose
-    `flash` inside shard_map paths, or `ring`/`ulysses`, explicitly."""
-    single_tpu = (
-        jax.default_backend() == "tpu" and len(jax.devices()) == 1
-    )
+    Mesh dispatch: on multi-device meshes where the SEQUENCE dim stays
+    whole per device (DP/FSDP/TP — batch and heads shard, not seq) the
+    kernel runs inside a shard_map over the batch/head axes
+    (`dot_product_attention` below), so multi-chip no longer falls back to
+    the O(S^2) einsum. When the mesh DOES shard the sequence (`context`
+    axis live), blockwise ring attention is the seq-partitioned strategy
+    and `auto` picks it when shapes divide. Off-mesh on a multi-device
+    backend the einsum remains the only partitionable path."""
+    if jax.default_backend() != "tpu" or seq_len < 2048:
+        return "xla"
     block_q = 128  # flash_attention's default q block
-    return (
-        "flash"
-        if single_tpu
-        and seq_len >= 2048
-        and seq_len % min(block_kv, seq_len) == 0
+    blocks_ok = (
+        seq_len % min(block_kv, seq_len) == 0
         and seq_len % min(block_q, seq_len) == 0
-        else "xla"
     )
+    # unusual head dims must fall back, not surface as Mosaic layout
+    # errors: the kernel's VMEM tiles want lane-friendly D (64/128/192/256).
+    # Explicit `attention: flash` bypasses this — an opt-in to the kernel.
+    head_ok = head_dim is None or (head_dim % 64 == 0 and head_dim <= 256)
+    flash_ok = blocks_ok and head_ok
+    from ..parallel.ring import current_mesh
+    from ..parallel.sharding import constraints_suspended
+
+    if constraints_suspended():
+        # inside a shard_map body (pipeline stage): seq_len is already the
+        # per-device view; the plain kernel applies directly
+        return "flash" if flash_ok else "xla"
+    mesh = current_mesh()
+    if mesh is None:
+        # no mesh bound: only a lone chip can run the unpartitioned kernel
+        return (
+            "flash" if flash_ok and len(jax.devices()) == 1 else "xla"
+        )
+    ctx = mesh.shape.get("context", 1)
+    if ctx > 1:
+        # the seq-partitioned strategy has no block/head-dim constraints
+        # (einsum-based ring body) — only the ring chunking must divide
+        return "ring" if seq_len % ctx == 0 else "xla"
+    return "flash" if flash_ok else "xla"
+
+
+def _flash_sharded(q, k, v, *, causal: bool, block_kv: int, mesh):
+    """The Pallas flash kernel on a live multi-device mesh.
+
+    The kernel has no GSPMD partitioning rule, so partition it manually:
+    shard_map over the axes that DON'T touch the sequence dim — batch over
+    data/fsdp, heads over model, seq and head_dim whole per device. Each
+    device then runs the ordinary single-device kernel on its [b/dp, S,
+    h/tp, D] block; no cross-device attention math is needed because every
+    (batch, head) pair lives wholly on one device. Axes whose size doesn't
+    divide the corresponding dim degrade to replication (mirroring
+    `parallel.sharding.constrain`), so odd shapes stay correct — just less
+    parallel. With seq sharded over `context` callers want ring/ulysses
+    instead; entering here anyway is correct (GSPMD gathers seq to match
+    the in_specs) but wasteful."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from .flash_attention import flash_attention
+    from ..parallel.mesh import BATCH_AXES
+    from ..parallel.sharding import live_axes, shard_map_nocheck
+
+    B, _, H, _ = q.shape
+    batch = live_axes(mesh, BATCH_AXES, B)
+    head = live_axes(mesh, ("model",), H)
+    spec = P(batch or None, None, head[0] if head else None, None)
+    body = partial(flash_attention, causal=causal, block_kv=block_kv)
+    fn = shard_map_nocheck(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return fn(q, k, v)
 
 
 def dot_product_attention(
@@ -52,10 +108,17 @@ def dot_product_attention(
 ):
     """q/k/v: [B, S, H, D], equal head counts (expand GQA first) → [B, S, H, D]."""
     if backend == "auto":
-        backend = resolve_auto_backend(q.shape[1], block_kv)
+        backend = resolve_auto_backend(q.shape[1], block_kv, q.shape[-1])
     if backend == "flash":
         from .flash_attention import flash_attention
+        from ..parallel.ring import current_mesh
+        from ..parallel.sharding import constraints_suspended
 
+        mesh = current_mesh()
+        if mesh is not None and mesh.size > 1 and not constraints_suspended():
+            return _flash_sharded(
+                q, k, v, causal=causal, block_kv=block_kv, mesh=mesh
+            )
         return flash_attention(q, k, v, causal=causal, block_kv=block_kv)
     if backend == "ring":
         from ..parallel.ring import ring_attention

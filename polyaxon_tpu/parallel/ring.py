@@ -109,12 +109,27 @@ def ring_attention(
     n = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
     scale = q.shape[-1] ** -0.5
     if n <= 1:
-        from ..ops.flash_attention import flash_attention
+        # no context axis: route through the flash dispatch so a live
+        # DP/FSDP/TP mesh still gets the shard_map-partitioned kernel
+        from ..ops.attention import dot_product_attention
 
-        return flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+        return dot_product_attention(
+            q, k, v, causal=causal, backend="flash", block_kv=block_kv
+        )
 
-    batch = tuple(ax for ax in BATCH_AXES if mesh.shape.get(ax, 1) > 1) or None
-    head = "model" if mesh.shape.get("model", 1) > 1 else None
+    if q.shape[1] % n:
+        # sequence doesn't divide the ring: the partitionable einsum is the
+        # only correct fallback on a live multi-device mesh
+        from ..ops.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal, backend="xla")
+    from .sharding import live_axes
+
+    # batch/head axes degrade to replication when they don't divide
+    # (e.g. B=1 eval batches on a data×context mesh)
+    batch = live_axes(mesh, BATCH_AXES, q.shape[0]) or None
+    head_live = live_axes(mesh, ("model",), q.shape[2])
+    head = head_live[0] if head_live else None
     spec = P(batch, axis_name, head, None)
     inner = shard_map(
         partial(_ring_body, axis_name=axis_name, n=n, scale=scale, causal=causal),

@@ -31,31 +31,59 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def live_axes(mesh: Mesh, axes, dim_size: int) -> tuple:
+    """Subset of `axes` present in `mesh` whose joint product divides
+    `dim_size` — the degrade-to-replication walk shared by the sharding
+    resolver, `constrain`, and the attention shard_map dispatches. An axis
+    that doesn't divide is dropped (replicate) while the rest keep
+    sharding; correctness over parallelism."""
+    live: list = []
+    size = 1
+    for a in axes:
+        if not a or mesh.shape.get(a, 1) == 1:
+            continue
+        if dim_size % (size * mesh.shape[a]) == 0:
+            live.append(a)
+            size *= mesh.shape[a]
+    return tuple(live)
+
+
+def _as_spec_entry(live: tuple):
+    if not live:
+        return None
+    return live[0] if len(live) == 1 else tuple(live)
+
+
 def _spec_for(path: str, shape, rules, mesh: Mesh) -> P:
     for pattern, axes in rules:
         if re.search(pattern, path):
             resolved = []
             for i, ax in enumerate(axes[: len(shape)]):
                 cands = ax if isinstance(ax, tuple) else (ax,)
-                live: list = []
-                size = 1
-                for a in cands:
-                    if a is None or mesh.shape.get(a, 1) == 1:
-                        continue
-                    if shape[i] % (size * mesh.shape[a]) == 0:
-                        live.append(a)
-                        size *= mesh.shape[a]
-                    # indivisible under this axis: drop it, keep the rest
-                if not live:
-                    resolved.append(None)
-                elif len(live) == 1:
-                    resolved.append(live[0])
-                else:
-                    resolved.append(tuple(live))
+                resolved.append(
+                    _as_spec_entry(live_axes(mesh, cands, shape[i]))
+                )
             while resolved and resolved[-1] is None:
                 resolved.pop()
             return P(*resolved)
     return P()  # replicate by default
+
+
+def shard_map_nocheck(body, **kwargs):
+    """shard_map with the replication check disabled across jax versions
+    (kwarg renamed check_rep → check_vma) — Pallas kernels inside the body
+    don't declare varying mesh axes, so the check must be skipped."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(body, check_vma=False, **kwargs)
+    except TypeError:
+        try:
+            return shard_map(body, check_rep=False, **kwargs)
+        except TypeError:  # oldest: neither kwarg
+            return shard_map(body, **kwargs)
 
 
 def param_shardings(params, rules: Sequence, mesh: Mesh):
@@ -105,6 +133,13 @@ def suspend_constraints():
         _CONSTRAIN_STATE.suspended = prev
 
 
+def constraints_suspended() -> bool:
+    """True while tracing inside a shard_map body (pipeline stages etc.) —
+    code that dispatches on 'is a global mesh in scope' must treat the
+    per-device view as single-device."""
+    return getattr(_CONSTRAIN_STATE, "suspended", False)
+
+
 def constrain(x, *axes):
     """`with_sharding_constraint` against the trainer-bound mesh
     (parallel/ring.current_mesh). Axes name logical mesh axes (or tuples of
@@ -124,22 +159,9 @@ def constrain(x, *axes):
     resolved = []
     for i, ax in enumerate(axes[: x.ndim]):
         cands = ax if isinstance(ax, tuple) else (ax,)
-        live: list = []
-        size = 1
-        for a in cands:
-            if not a or mesh.shape.get(a, 1) == 1:
-                continue
-            # indivisible dims degrade to replication (e.g. a module traced
-            # directly with a small batch while a big-mesh is bound)
-            if x.shape[i] % (size * mesh.shape[a]) == 0:
-                live.append(a)
-                size *= mesh.shape[a]
-        if not live:
-            resolved.append(None)
-        elif len(live) == 1:
-            resolved.append(live[0])
-        else:
-            resolved.append(tuple(live))
+        # indivisible dims degrade to replication (e.g. a module traced
+        # directly with a small batch while a big-mesh is bound)
+        resolved.append(_as_spec_entry(live_axes(mesh, cands, x.shape[i])))
     while resolved and resolved[-1] is None:
         resolved.pop()
     return jax.lax.with_sharding_constraint(

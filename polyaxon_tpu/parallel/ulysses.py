@@ -62,29 +62,37 @@ def ulysses_attention(
     mesh = current_mesh()
     n = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
     if n <= 1:
-        from ..ops.flash_attention import flash_attention
+        # no context axis: route through the flash dispatch so a live
+        # DP/FSDP/TP mesh still gets the shard_map-partitioned kernel
+        from ..ops.attention import dot_product_attention
 
-        return flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+        return dot_product_attention(
+            q, k, v, causal=causal, backend="flash", block_kv=block_kv
+        )
 
-    model_deg = mesh.shape.get("model", 1)
-    local_heads = q.shape[2] // model_deg if model_deg > 1 else q.shape[2]
+    if q.shape[1] % n:
+        # sequence doesn't divide the context degree: the partitionable
+        # einsum is the only correct fallback on a live multi-device mesh
+        from ..ops.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal, backend="xla")
+    from .sharding import live_axes, shard_map_nocheck
+
+    head_live = live_axes(mesh, ("model",), q.shape[2])
+    local_heads = q.shape[2] // mesh.shape["model"] if head_live else q.shape[2]
     if local_heads % n != 0:
         raise ValueError(
             f"ulysses needs local head count {local_heads} divisible by the "
             f"context degree {n} (heads are scattered); use attention: ring "
             "for this shape"
         )
-    batch = tuple(ax for ax in BATCH_AXES if mesh.shape.get(ax, 1) > 1) or None
-    head = "model" if model_deg > 1 else None
-    spec = P(batch, axis_name, head, None)
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    # batch degrades to replication when it doesn't divide (B=1 eval)
+    batch = live_axes(mesh, BATCH_AXES, q.shape[0]) or None
+    spec = P(batch, axis_name, head_live[0] if head_live else None, None)
     body = partial(
         _ulysses_body, axis_name=axis_name, causal=causal, block_kv=block_kv
     )
-    try:
-        # the Pallas flash kernel inside the map doesn't declare varying
-        # mesh axes; skip the vma check (newer jax only)
-        inner = shard_map(body, check_vma=False, **kwargs)
-    except TypeError:  # older jax: kwarg absent, check doesn't exist either
-        inner = shard_map(body, **kwargs)
+    inner = shard_map_nocheck(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
     return inner(q, k, v)

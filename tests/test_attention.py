@@ -82,6 +82,33 @@ def test_ring_backward_matches_xla():
         set_current_mesh(None)
 
 
+def test_ring_degrades_indivisible_batch():
+    """B=1 (eval/decode) on a data×context mesh: the batch axis degrades to
+    replication instead of a shard_map divisibility error."""
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(B=1, S=64)
+        ref = dot_product_attention(q, k, v, causal=True, backend="xla")
+        out = ring_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ring_falls_back_to_xla_on_indivisible_seq():
+    """S not divisible by the context degree: einsum fallback, same math."""
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(S=66)
+        ref = dot_product_attention(q, k, v, causal=True, backend="xla")
+        out = ring_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
 def test_ring_falls_back_without_context_axis():
     set_current_mesh(None)
     q, k, v = _qkv(S=64)
@@ -195,18 +222,92 @@ def test_trainer_ulysses_attention_end_to_end(tmp_home):
     assert result.history[-1]["loss"] == result.history[-1]["loss"]
 
 
-def test_auto_backend_resolution():
-    """`auto` picks flash only on a SINGLE TPU chip with long,
-    block-aligned shapes — any multi-device environment (this suite's
-    8-CPU virtual slice included) stays on the partitionable einsum."""
+def test_auto_backend_resolution(monkeypatch):
+    """`auto` picks the flash kernel on TPU whenever the sequence dim stays
+    whole per device (single chip, or DP/FSDP/TP meshes via the shard_map
+    dispatch); ring when the mesh shards the sequence; einsum for short or
+    block-misaligned shapes and off-mesh multi-device tracing."""
     import jax
 
     from polyaxon_tpu.ops.attention import resolve_auto_backend
 
-    if jax.default_backend() == "tpu" and len(jax.devices()) == 1:
-        # pragma: no cover — chip-only branch
+    # off-TPU (this suite's CPU slice): always the einsum
+    assert resolve_auto_backend(4096, 512) == "xla"
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    set_current_mesh(None)
+    assert resolve_auto_backend(1024, 512) == "xla"  # short seq
+    assert resolve_auto_backend(2496, 192) == "xla"  # % block_q fails
+    assert resolve_auto_backend(4096, 512, head_dim=80) == "xla"  # odd D
+    assert resolve_auto_backend(4096, 512, head_dim=512) == "xla"  # huge D
+    # no mesh bound: only a lone device can run the unpartitioned kernel
+    expect = "flash" if len(jax.devices()) == 1 else "xla"
+    assert resolve_auto_backend(4096, 512) == expect
+
+    try:
+        # seq whole per device -> flash via the shard_map dispatch
+        set_current_mesh(build_mesh({"data": 2, "fsdp": 2, "model": 2}))
         assert resolve_auto_backend(4096, 512) == "flash"
-        assert resolve_auto_backend(1024, 512) == "xla"  # short seq
-        assert resolve_auto_backend(2496, 192) == "xla"  # % block_q fails
-    else:
-        assert resolve_auto_backend(4096, 512) == "xla"
+        # seq sharded over context -> ring
+        set_current_mesh(build_mesh({"data": 2, "context": 4}))
+        assert resolve_auto_backend(4096, 512) == "ring"
+    finally:
+        set_current_mesh(None)
+
+    # inside a shard_map body the per-device view is single-device
+    from polyaxon_tpu.parallel.sharding import suspend_constraints
+
+    with suspend_constraints():
+        assert resolve_auto_backend(4096, 512) == "flash"
+
+
+@pytest.mark.parametrize("axes", [{"data": 2, "fsdp": 2, "model": 2},
+                                  {"fsdp": 4, "model": 2}])
+def test_flash_sharded_matches_xla(axes):
+    """backend=flash on a live multi-device mesh == the einsum reference:
+    the shard_map dispatch partitions batch over data/fsdp and heads over
+    model while keeping the sequence whole per device."""
+    mesh = build_mesh(axes)
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(B=4, S=64, H=4, D=32)
+        ref = dot_product_attention(q, k, v, causal=True, backend="xla")
+        out = dot_product_attention(q, k, v, causal=True, backend="flash")
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+@pytest.mark.slow
+def test_flash_sharded_backward_matches_xla():
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(B=4, S=64, H=4, D=32)
+        g1 = jax.grad(
+            lambda q: dot_product_attention(
+                q, k, v, causal=True, backend="flash"
+            ).sum()
+        )(q)
+        g2 = jax.grad(
+            lambda q: dot_product_attention(
+                q, k, v, causal=True, backend="xla"
+            ).sum()
+        )(q)
+        np.testing.assert_allclose(g1, g2, atol=5e-5, rtol=5e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_flash_sharded_degrades_indivisible_dims():
+    """Odd batch/head counts degrade those axes to replication instead of
+    erroring — correctness over parallelism."""
+    mesh = build_mesh({"data": 2, "model": 4})
+    set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(B=2, S=64, H=3, D=32)  # H=3 % model=4 fails
+        ref = dot_product_attention(q, k, v, causal=True, backend="xla")
+        out = dot_product_attention(q, k, v, causal=True, backend="flash")
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)

@@ -141,3 +141,25 @@ def test_pipeline_gradients_match_sequential():
             )
     finally:
         set_current_mesh(None)
+
+
+# ------------------------------------------------------- composed meshes
+# Strategies must COMPOSE, not just coexist (SURVEY.md §2 parallelism
+# census) — mirrors __graft_entry__.dryrun_multichip's composed modes.
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "axes,cfg",
+    [
+        ({"model": 2, "context": 2, "data": 2}, {"attention": "ring"}),
+        (
+            {"model": 2, "pipeline": 2, "data": 2},
+            {"pipeline_stages": 2, "pipeline_microbatches": 2},
+        ),
+        ({"fsdp": 2, "expert": 2, "data": 2}, {"n_experts": 2}),
+    ],
+    ids=["tp+context+dp", "tp+pipeline+dp", "fsdp+expert+dp"],
+)
+def test_composed_mesh_trains(axes, cfg):
+    trainer = Trainer(_prog(cfg, steps=2), mesh_axes=axes)
+    result = trainer.run()
+    assert np.isfinite(result.history[-1]["loss"])
