@@ -232,7 +232,7 @@ def _validate_mesh(component: V1Component) -> V1Component:
     if tpu is None:
         # no slice declared: single host/local run; -1 axes resolve at runtime
         return component
-    n_chips = tpu.num_chips
+    n_chips = tpu.total_chips  # all slices: the mesh spans the whole job
     fixed = math.prod(v for v in sizes.values() if v != -1) if sizes else 1
     if any(v == -1 for v in sizes.values()):
         if n_chips % fixed != 0:
@@ -243,6 +243,13 @@ def _validate_mesh(component: V1Component) -> V1Component:
     elif sizes and fixed != n_chips:
         raise CompilationError(
             f"mesh axes {sizes} multiply to {fixed} but tpu slice has {n_chips} chips"
+        )
+    if tpu.num_slices > 1 and sizes.get("data", 1) % tpu.num_slices:
+        # only the data axis spans DCN; every other axis must fit in a slice
+        raise CompilationError(
+            f"multi-slice job ({tpu.num_slices} slices) needs mesh data axis "
+            f"divisible by the slice count, got data={sizes.get('data', 1)} "
+            f"(mesh {sizes}); tensor/context/expert axes never cross DCN"
         )
     new_mesh = V1MeshSpec(**sizes)
     new_run = run.model_copy(update={"mesh": new_mesh})

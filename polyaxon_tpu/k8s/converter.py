@@ -131,11 +131,24 @@ def _volumes(connections: list) -> tuple[list[dict], list[dict]]:
     return volumes, mounts
 
 
-def _main_container(compiled: CompiledOperation, tpu, n_hosts: int, port: int) -> dict:
+def _main_container(
+    compiled: CompiledOperation,
+    tpu,
+    n_hosts: int,
+    port: int,
+    *,
+    slice_id: int = 0,
+    n_slices: int = 1,
+) -> dict:
     run = compiled.run
     chips_per_host = CHIPS_PER_HOST.get(tpu.type, 4) if tpu else 0
     c = run.container
     svc = f"{compiled.name}-hosts"
+    total_processes = n_hosts * n_slices
+    # rendezvous at slice 0's pod 0 (pods of slice i are named {name}-s{i}-*
+    # on multi-slice jobs, {name}-* otherwise)
+    coord_pod = f"{compiled.name}-s0-0" if n_slices > 1 else f"{compiled.name}-0"
+    coordinator = f"{coord_pod}.{svc}:{port}"
     if c is not None and (c.command or c.args):
         command = list(c.command or [])
         args = list(c.args or [])
@@ -147,10 +160,16 @@ def _main_container(compiled: CompiledOperation, tpu, n_hosts: int, port: int) -
         command = ["polyaxon-launcher"]
         args = [
             "--num-workers", "1",
-            # global rank = this pod's completion index; gang size = hosts
+            # global rank = slice base + this pod's completion index;
+            # gang size = hosts across ALL slices
             "--process-id-offset", "env:JOB_COMPLETION_INDEX",
-            "--total-processes", str(n_hosts),
-            "--coordinator", f"{compiled.name}-0.{svc}:{port}",
+            *(
+                ["--process-id-base", str(slice_id * n_hosts)]
+                if n_slices > 1
+                else []
+            ),
+            "--total-processes", str(total_processes),
+            "--coordinator", coordinator,
             "--env", "POLYAXON_PROGRAM_SPEC=/polyaxon-context/program.json",
             "--", "python", "-m", "polyaxon_tpu.runtime.worker",
         ]
@@ -161,7 +180,7 @@ def _main_container(compiled: CompiledOperation, tpu, n_hosts: int, port: int) -
         "args": args,
         "env": _run_env(compiled)
         + [
-            {"name": "JAX_NUM_PROCESSES", "value": str(n_hosts)},
+            {"name": "JAX_NUM_PROCESSES", "value": str(total_processes)},
             # indexed Jobs also export JOB_COMPLETION_INDEX natively; the
             # explicit fieldRef keeps the manifest self-describing — the
             # launcher turns it into each worker's global JAX_PROCESS_ID
@@ -173,8 +192,28 @@ def _main_container(compiled: CompiledOperation, tpu, n_hosts: int, port: int) -
                     }
                 },
             },
-            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{compiled.name}-0.{svc}:{port}"},
-        ],
+            {"name": "JAX_COORDINATOR_ADDRESS", "value": coordinator},
+        ]
+        + (
+            # megascale wiring: libtpu joins the slices over DCN from these.
+            # JAX_PROCESS_ID_BASE is the contract for CUSTOM commands (which
+            # don't get the launcher's --process-id-base): global rank =
+            # base + JOB_COMPLETION_INDEX
+            [
+                {"name": "MEGASCALE_NUM_SLICES", "value": str(n_slices)},
+                {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
+                {
+                    "name": "MEGASCALE_COORDINATOR_ADDRESS",
+                    "value": f"{coord_pod}.{svc}",
+                },
+                {
+                    "name": "JAX_PROCESS_ID_BASE",
+                    "value": str(slice_id * n_hosts),
+                },
+            ]
+            if n_slices > 1
+            else []
+        ),
         "volumeMounts": [CONTEXT_MOUNT, ARTIFACTS_MOUNT],
         "ports": [{"containerPort": port, "name": "coordinator"}],
     }
@@ -206,14 +245,13 @@ def convert_jaxjob(
     run = compiled.run
     tpu = _tpu_of(compiled)
     if tpu is not None:
-        n_hosts = max(1, tpu.num_chips // CHIPS_PER_HOST.get(tpu.type, 4))
+        n_hosts = tpu.num_hosts  # per slice; ceil — partial hosts count
     else:
         n_hosts = int(getattr(run, "replicas", 1) or 1)
+    n_slices = tpu.num_slices if tpu is not None else 1
     env = getattr(run, "environment", None)
     conns = _resolve_connections(run, catalog)
     volumes, conn_mounts = _volumes(conns)
-    main = _main_container(compiled, tpu, n_hosts, coordinator_port)
-    main["volumeMounts"] = main["volumeMounts"] + conn_mounts
 
     init_specs = []
     if run.program is not None:
@@ -226,6 +264,7 @@ def convert_jaxjob(
                 "runUuid": compiled.run_uuid,
                 "program": run.program.to_dict(),
                 "mesh": run.mesh.axis_sizes() if run.mesh else None,
+                "slices": n_slices,
             }
         )
         init_specs.append(
@@ -264,40 +303,72 @@ def convert_jaxjob(
             "ports": [{"port": coordinator_port, "name": "coordinator"}],
         },
     }
-    pod_spec: dict[str, Any] = {
-        "subdomain": svc_name,
-        "restartPolicy": "Never",  # gang restarts are operator-level
-        "containers": [
-            main,
-            sidecar_container(run_uuid=compiled.run_uuid),
-        ],
-        "volumes": volumes,
-        **_pod_scheduling(env, tpu),
-    }
-    if init_specs:
-        pod_spec["initContainers"] = init_specs
     term = compiled.component.termination
-    job = {
-        "apiVersion": "batch/v1",
-        "kind": "Job",
-        "metadata": {"name": compiled.name, "namespace": namespace, "labels": labels},
-        "spec": {
-            "completionMode": "Indexed",
-            "completions": n_hosts,
-            "parallelism": n_hosts,
-            "backoffLimit": (term.max_retries if term and term.max_retries else 0),
-            **(
-                {"activeDeadlineSeconds": int(term.timeout)}
-                if term and term.timeout
-                else {}
-            ),
-            "template": {
-                "metadata": {"labels": labels, "annotations": dict(env.annotations or {}) if env else {}},
-                "spec": pod_spec,
-            },
-        },
-    }
-    return [service, job]
+    jobs = []
+    # one indexed gang Job per slice; single-slice keeps the unsuffixed
+    # name so existing manifests/goldens are unchanged
+    for slice_id in range(n_slices):
+        main = _main_container(
+            compiled,
+            tpu,
+            n_hosts,
+            coordinator_port,
+            slice_id=slice_id,
+            n_slices=n_slices,
+        )
+        main["volumeMounts"] = main["volumeMounts"] + conn_mounts
+        job_labels = dict(labels)
+        if n_slices > 1:
+            job_labels["polyaxon/slice"] = str(slice_id)
+        pod_spec: dict[str, Any] = {
+            "subdomain": svc_name,
+            "restartPolicy": "Never",  # gang restarts are operator-level
+            "containers": [
+                main,
+                sidecar_container(run_uuid=compiled.run_uuid),
+            ],
+            "volumes": volumes,
+            **_pod_scheduling(env, tpu),
+        }
+        if init_specs:
+            pod_spec["initContainers"] = init_specs
+        job_name = (
+            f"{compiled.name}-s{slice_id}" if n_slices > 1 else compiled.name
+        )
+        jobs.append(
+            {
+                "apiVersion": "batch/v1",
+                "kind": "Job",
+                "metadata": {
+                    "name": job_name,
+                    "namespace": namespace,
+                    "labels": job_labels,
+                },
+                "spec": {
+                    "completionMode": "Indexed",
+                    "completions": n_hosts,
+                    "parallelism": n_hosts,
+                    "backoffLimit": (
+                        term.max_retries if term and term.max_retries else 0
+                    ),
+                    **(
+                        {"activeDeadlineSeconds": int(term.timeout)}
+                        if term and term.timeout
+                        else {}
+                    ),
+                    "template": {
+                        "metadata": {
+                            "labels": job_labels,
+                            "annotations": dict(env.annotations or {})
+                            if env
+                            else {},
+                        },
+                        "spec": pod_spec,
+                    },
+                },
+            }
+        )
+    return [service, *jobs]
 
 
 def _resolve_connections(run, catalog: Optional[ConnectionCatalog]) -> list:

@@ -53,9 +53,12 @@ struct Options {
   long timeout_s = 0;  // 0 = none
   // multi-host: this host's global rank offset and the gang-wide process
   // count. --process-id-offset accepts a number or "env:VAR" (e.g.
-  // env:JOB_COMPLETION_INDEX on an indexed k8s Job); --total-processes
-  // defaults to num_workers (single-host).
+  // env:JOB_COMPLETION_INDEX on an indexed k8s Job); --process-id-base is
+  // a constant added on top (multi-slice jobs: base = slice_id *
+  // hosts_per_slice, offset = within-slice completion index);
+  // --total-processes defaults to num_workers (single-host).
   std::string process_id_offset = "0";
+  int process_id_base = 0;
   int total_processes = 0;
   std::vector<std::string> extra_env;
   std::vector<char*> command;
@@ -65,9 +68,9 @@ int resolve_offset(const Options& opt) {
   const std::string& s = opt.process_id_offset;
   if (s.rfind("env:", 0) == 0) {
     const char* v = getenv(s.c_str() + 4);
-    return v ? std::atoi(v) : 0;
+    return opt.process_id_base + (v ? std::atoi(v) : 0);
   }
-  return std::atoi(s.c_str());
+  return opt.process_id_base + std::atoi(s.c_str());
 }
 
 void usage(const char* argv0) {
@@ -98,6 +101,8 @@ Options parse_args(int argc, char** argv) {
       opt.timeout_s = std::atol(next());
     } else if (a == "--process-id-offset") {
       opt.process_id_offset = next();
+    } else if (a == "--process-id-base") {
+      opt.process_id_base = std::atoi(next());
     } else if (a == "--total-processes") {
       opt.total_processes = std::atoi(next());
     } else if (a == "--env") {

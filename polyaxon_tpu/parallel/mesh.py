@@ -49,9 +49,24 @@ def resolve_axis_sizes(
 def build_mesh(
     spec_sizes: Optional[dict[str, int]] = None,
     devices: Optional[list] = None,
+    *,
+    slices: int = 1,
 ) -> Mesh:
+    """One mesh over all devices; `slices > 1` builds a hybrid ICI×DCN mesh.
+
+    Multi-slice (SURVEY.md §2:120-121 "ICI within a slice, DCN across
+    slices"): the `data` axis is split DCN-major — its outer part strides
+    across slices, its inner part and every other axis stay inside one
+    slice. Gradient all-reduces then decompose into a fast intra-slice
+    reduce-scatter over ICI plus a small cross-slice all-reduce over DCN,
+    while tensor/context/expert collectives never leave the slice —
+    `mesh_utils.create_hybrid_device_mesh` semantics. On hardware the real
+    slice assignment comes from `device.slice_index`; on virtual/CPU
+    slices the device list is treated as `slices` contiguous blocks."""
     devices = devices if devices is not None else jax.devices()
     sizes = resolve_axis_sizes(spec_sizes, len(devices))
+    if slices > 1:
+        return _build_hybrid_mesh(sizes, devices, slices)
     try:
         # mesh_utils knows the physical ICI topology (it reads device coords)
         # and lays logical axes onto it to keep inner axes on adjacent chips
@@ -63,6 +78,49 @@ def build_mesh(
     except Exception:
         dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
     return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def _build_hybrid_mesh(sizes: dict[str, int], devices, slices: int) -> Mesh:
+    if len(devices) % slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {slices} slices"
+        )
+    data = sizes.get("data", 1)
+    if data % slices:
+        raise ValueError(
+            f"multi-slice meshes split the data axis across slices: "
+            f"data={data} must be divisible by slices={slices} "
+            f"(mesh {sizes})"
+        )
+    per_slice = dict(sizes)
+    per_slice["data"] = data // slices
+    axes = tuple(per_slice.keys())
+    if all(hasattr(d, "slice_index") for d in devices):
+        # real multi-slice hardware: mesh_utils groups by slice_index. Do
+        # NOT fall back here — a wrong layout would silently put
+        # model/context collectives on DCN
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(per_slice.values()),
+            dcn_mesh_shape=tuple(
+                slices if ax == "data" else 1 for ax in axes
+            ),
+            devices=devices,
+        )
+    else:
+        # virtual slices (CPU tests / dryrun): contiguous device blocks per
+        # slice; the data axis is laid out slice-major so index i of the
+        # global data axis maps to slice i // (data/slices)
+        arr = np.asarray(devices).reshape(
+            (slices,) + tuple(per_slice.values())
+        )
+        data_idx = list(axes).index("data")
+        arr = np.moveaxis(arr, 0, data_idx)
+        shape = list(per_slice.values())
+        shape[data_idx] = data
+        dev_array = arr.reshape(tuple(shape))
+    return Mesh(dev_array, axes)
 
 
 def local_batch_slice(mesh: Mesh) -> int:

@@ -510,6 +510,9 @@ class Executor:
         run = compiled.run
         store, run_uuid = self.store, compiled.run_uuid
         mesh_axes = run.mesh.axis_sizes() if run.mesh else None
+        from ..schemas.run_kinds import run_num_slices
+
+        n_slices = run_num_slices(run)
 
         ckpt_dir = None
         tspec = run.program.train
@@ -545,6 +548,7 @@ class Executor:
             program,
             mesh_axes=mesh_axes,
             devices=self.devices,
+            slices=n_slices,
             log_fn=log_fn,
             checkpoint_dir=ckpt_dir,
             artifacts_dir=str(store.outputs_dir(run_uuid)),
@@ -580,10 +584,13 @@ class Executor:
 
         run = compiled.run
         store, run_uuid = self.store, compiled.run_uuid
+        from ..schemas.run_kinds import run_num_slices
+
         payload = {
             "runUuid": run_uuid,
             "program": program.to_dict(),
             "mesh": run.mesh.axis_sizes() if run.mesh else None,
+            "slices": run_num_slices(run),
         }
         if ckpt_dir is not None:
             payload["checkpointDir"] = ckpt_dir

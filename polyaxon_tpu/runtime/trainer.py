@@ -84,6 +84,7 @@ class Trainer:
         *,
         mesh_axes: Optional[dict[str, int]] = None,
         devices: Optional[list] = None,
+        slices: int = 1,
         log_fn: Optional[Callable[[int, dict], None]] = None,
         checkpoint_dir: Optional[str] = None,
         artifacts_dir: Optional[str] = None,
@@ -121,7 +122,7 @@ class Trainer:
             total_steps=self.steps,
         )
         self.loss_fn = build_loss(tspec.loss or self.bundle.loss)
-        self.mesh = build_mesh(mesh_axes, devices=devices)
+        self.mesh = build_mesh(mesh_axes, devices=devices, slices=slices)
         # model-internal collectives (ring attention, MoE all-to-all) read
         # the mesh from this context var at trace time
         from ..parallel.ring import set_current_mesh

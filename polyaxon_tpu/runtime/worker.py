@@ -75,6 +75,7 @@ def main() -> int:
     trainer = Trainer(
         program,
         mesh_axes=payload.get("mesh"),
+        slices=int(payload.get("slices") or 1),
         log_fn=log_fn,
         # all processes participate in (multi-host) checkpointing
         checkpoint_dir=payload.get("checkpointDir"),

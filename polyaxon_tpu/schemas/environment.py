@@ -31,15 +31,27 @@ class V1TpuSpec(BaseSchema):
     """TPU slice request: `tpu: {type: v5e, topology: 4x8}`.
 
     `topology` is an ICI grid like "2x4" or "4x4x4"; `count` may be given
-    instead for a 1-D slice. Used by the converter to pick node selectors
+    instead for a 1-D slice. `slices: N` requests a MULTI-SLICE job: N
+    identical slices joined over DCN (SURVEY.md §2:120-121) — the
+    converter renders one gang Job per slice with megascale env wiring,
+    and the mesh builder lays the `data` axis DCN-major across slices
+    (parallel/mesh.py). Used by the converter to pick node selectors
     (`google.com/tpu`, `cloud.google.com/gke-tpu-topology`) and by the
-    parallel layer to build the device mesh (parallel/mesh.py).
+    parallel layer to build the device mesh.
     """
 
     type: str = "v5e"
     topology: Optional[str] = None
     count: Optional[int] = None
     megacore: Optional[bool] = None
+    slices: Optional[int] = None
+
+    @field_validator("slices")
+    @classmethod
+    def _check_slices(cls, v: Optional[int]) -> Optional[int]:
+        if v is not None and v < 1:
+            raise ValueError(f"slices must be >= 1, got {v}")
+        return v
 
     @field_validator("type")
     @classmethod
@@ -77,12 +89,26 @@ class V1TpuSpec(BaseSchema):
 
     @property
     def num_chips(self) -> int:
+        """Chips in ONE slice (`topology`/`count` describe a single slice)."""
         return math.prod(self.dims)
 
     @property
     def num_hosts(self) -> int:
+        """Hosts in ONE slice."""
         per_host = CHIPS_PER_HOST[self.type]
         return max(1, -(-self.num_chips // per_host))  # ceil: partial hosts count
+
+    @property
+    def num_slices(self) -> int:
+        return self.slices or 1
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_chips * self.num_slices
+
+    @property
+    def total_hosts(self) -> int:
+        return self.num_hosts * self.num_slices
 
 
 class V1ResourceRequirements(BaseSchema):

@@ -355,6 +355,14 @@ RUN_KINDS: dict[str, type] = {
 }
 
 
+def run_num_slices(run) -> int:
+    """Slice count of a run's `tpu:` block (1 when absent) — the single
+    accessor for multi-slice plumbing (executor → worker payloads)."""
+    env = getattr(run, "environment", None)
+    tpu = env.resources.tpu if env and env.resources else None
+    return tpu.num_slices if tpu is not None else 1
+
+
 def parse_run(data: dict) -> V1RunKind:
     kind = data.get("kind")
     if kind not in RUN_KINDS:

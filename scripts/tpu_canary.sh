@@ -27,6 +27,9 @@ while true; do
     cat tpu_results/bench_tpu.json >> "$log"
     if ! grep -q '"platform": "tpu"' tpu_results/bench_tpu.json; then
       echo "bench fell back to cpu; retrying loop" >> "$log"
+      # never leave CPU numbers on disk under a _tpu filename — an
+      # end-of-round snapshot must not mistake them for chip evidence
+      rm -f tpu_results/bench_tpu.json
       sleep 90
       continue
     fi

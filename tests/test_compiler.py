@@ -154,6 +154,36 @@ class TestMeshValidation:
         with pytest.raises(CompilationError, match="tpu"):
             compile_operation(op)
 
+    def _with_slices(self, mesh, slices):
+        op = jaxjob_op()
+        return _op(
+            {
+                **op.to_dict(),
+                "runPatch": {
+                    "mesh": mesh,
+                    "environment": {
+                        "resources": {
+                            "tpu": {
+                                "type": "v5e",
+                                "topology": "2x4",
+                                "slices": slices,
+                            }
+                        }
+                    },
+                },
+            }
+        )
+
+    def test_multislice_mesh_spans_all_slices(self):
+        # 2x4 = 8 chips per slice, 2 slices -> 16-chip mesh
+        c = compile_operation(self._with_slices({"data": -1, "model": 2}, 2))
+        assert c.run.mesh.axis_sizes() == {"data": 8, "model": 2}
+
+    def test_multislice_data_axis_must_divide(self):
+        # data=1 cannot span 2 slices; model never crosses DCN
+        with pytest.raises(CompilationError, match="slice"):
+            compile_operation(self._with_slices({"data": 1, "model": 16}, 2))
+
 
 class TestLegacyKinds:
     def _legacy(self, kind, groups):

@@ -215,3 +215,67 @@ def test_distributed_gang_with_model_axis(tmp_home, tmp_path):
         assert health and health[0]["devices"] == 4  # global mesh assembled
     finally:
         os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+
+@pytest.mark.slow
+def test_distributed_multislice_gang(tmp_home, tmp_path):
+    """2 jax.distributed processes standing in for 2 TPU slices: the tpu
+    block's `slices: 2` reaches the workers, whose hybrid mesh lays the
+    data axis slice-major (process-contiguous device blocks), and one
+    train step executes across the DCN-like process boundary."""
+    import yaml
+
+    from polyaxon_tpu.compiler.resolver import compile_operation
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.runtime.executor import Executor
+    from polyaxon_tpu.schemas.lifecycle import V1Statuses
+    from polyaxon_tpu.store.local import RunStore
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "dist-multislice",
+        "component": {
+            "kind": "component",
+            "name": "dist-multislice",
+            "run": {
+                "kind": "jaxjob",
+                "replicas": 2,
+                "mesh": {"data": 4},
+                "program": {
+                    "model": {
+                        "name": "transformer_lm",
+                        "config": {
+                            "dim": 64, "n_layers": 2, "n_heads": 4,
+                            "n_kv_heads": 4, "vocab_size": 512, "seq_len": 32,
+                        },
+                    },
+                    "data": {
+                        "name": "synthetic_text",
+                        "batchSize": 8,
+                        "config": {"seq_len": 32, "vocab_size": 512},
+                    },
+                    "optimizer": {"name": "adamw", "learningRate": 0.001},
+                    "train": {"steps": 2, "logEvery": 2, "precision": "float32"},
+                },
+                "environment": {
+                    "resources": {
+                        "tpu": {"type": "v5e", "count": 2, "slices": 2}
+                    }
+                },
+            },
+        },
+    }
+    p = tmp_path / "dist_multislice.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    os.environ["JAX_NUM_CPU_DEVICES"] = "2"  # 2 devices/proc -> 4 global
+    try:
+        store = RunStore()
+        compiled = compile_operation(
+            read_polyaxonfile(str(p)), artifacts_root=str(store.runs_dir)
+        )
+        assert Executor(store).execute(compiled) == V1Statuses.SUCCEEDED
+        metrics = store.read_metrics(compiled.run_uuid)
+        assert metrics and metrics[-1]["step"] == 2
+    finally:
+        os.environ.pop("JAX_NUM_CPU_DEVICES", None)

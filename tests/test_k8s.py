@@ -183,3 +183,39 @@ def test_dag_kind_not_convertible(tmp_path, tmp_home):
     compiled = _compile(tmp_path, spec)
     with pytest.raises(ConversionError):
         convert_operation(compiled)
+
+
+def test_jaxjob_multislice_renders_one_job_per_slice(tmp_path, tmp_home):
+    """tpu slices: 2 -> one gang Job per slice sharing the headless service,
+    slice-offset ranks, gang size across all slices, megascale env."""
+    import copy
+
+    spec = copy.deepcopy(JAXJOB_SPEC)
+    spec["component"]["run"]["environment"]["resources"]["tpu"]["slices"] = 2
+    spec["component"]["run"]["mesh"] = {"data": -1, "model": 2}
+    compiled = _compile(tmp_path, spec)
+    service, *jobs = convert_operation(compiled)
+
+    assert service["kind"] == "Service"
+    assert len(jobs) == 2
+    assert [j["metadata"]["name"] for j in jobs] == [
+        "bert-pretrain-s0",
+        "bert-pretrain-s1",
+    ]
+    for slice_id, job in enumerate(jobs):
+        spec_ = job["spec"]
+        assert spec_["completions"] == 8  # hosts PER SLICE
+        assert job["metadata"]["labels"]["polyaxon/slice"] == str(slice_id)
+        main = spec_["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        # gang spans both slices; ranks offset by slice base
+        assert env["JAX_NUM_PROCESSES"] == "16"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == str(slice_id)
+        args = main["args"]
+        assert "--total-processes" in args
+        assert args[args.index("--total-processes") + 1] == "16"
+        if slice_id:
+            assert args[args.index("--process-id-base") + 1] == "8"
+        # every slice rendezvouses at slice 0's pod 0
+        assert env["JAX_COORDINATOR_ADDRESS"].startswith("bert-pretrain-s0-0.")

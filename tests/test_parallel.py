@@ -163,3 +163,41 @@ def test_composed_mesh_trains(axes, cfg):
     trainer = Trainer(_prog(cfg, steps=2), mesh_axes=axes)
     result = trainer.run()
     assert np.isfinite(result.history[-1]["loss"])
+
+
+# ------------------------------------------------------- multi-slice mesh
+def test_hybrid_mesh_data_axis_is_slice_major():
+    """slices=2 on 8 virtual devices: the data axis's outer half lives in
+    slice 0 (first device block), the inner structure inside a slice —
+    create_hybrid_device_mesh semantics on virtual slices."""
+    from polyaxon_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 4, "model": 2}, slices=2)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    devs = mesh.devices  # [data=4, model=2]
+    ids = [[d.id for d in row] for row in devs]
+    first_half = {i for row in ids[:2] for i in row}
+    second_half = {i for row in ids[2:] for i in row}
+    assert first_half == {0, 1, 2, 3}, ids  # slice 0 block
+    assert second_half == {4, 5, 6, 7}, ids  # slice 1 block
+
+
+def test_hybrid_mesh_requires_divisible_data_axis():
+    from polyaxon_tpu.parallel.mesh import build_mesh
+
+    with pytest.raises(ValueError, match="divisible by slices"):
+        build_mesh({"model": 8}, slices=2)  # no data axis to span DCN
+
+
+@pytest.mark.slow
+def test_multislice_trainer_end_to_end():
+    trainer = Trainer(
+        _prog({}, steps=2), mesh_axes={"data": 4, "model": 2}, slices=2
+    )
+    result = trainer.run()
+    assert np.isfinite(result.history[-1]["loss"])
+
+
+def test_trainer_rejects_indivisible_slices():
+    with pytest.raises(ValueError, match="divisible by slices"):
+        Trainer(_prog({}), mesh_axes={"data": 2, "model": 4}, slices=4)
