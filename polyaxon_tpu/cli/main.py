@@ -419,12 +419,36 @@ def agent():
 @click.option("--poll-interval", default=1.0, type=float)
 @click.option("--queue", "queues", multiple=True,
               help="only drain these queues (repeatable); default: all")
-def agent_start(poll_interval, queues):
+@click.option("--cluster/--local", "use_cluster", default=False,
+              help="submit runs to k8s via kubectl instead of executing "
+                   "in-process; the serve loop then reconciles pod phases")
+@click.option("--namespace", default="polyaxon", show_default=True)
+@click.option("--context", "kube_context", default=None,
+              help="kubeconfig context for --cluster")
+@click.option("--kube-dry-run", is_flag=True, default=False,
+              help="validate manifests with kubectl --dry-run=client "
+                   "instead of really submitting")
+def agent_start(poll_interval, queues, use_cluster, namespace, kube_context,
+                kube_dry_run):
     from ..scheduler import Agent
 
+    store = RunStore()
     which = ", ".join(queues) if queues else "all queues"
+    kwargs = {}
+    if use_cluster:
+        from ..k8s.cluster import KubectlCluster
+        from ..scheduler.reconciler import ClusterSubmitter
+
+        cluster = KubectlCluster(
+            namespace=namespace, context=kube_context, dry_run=kube_dry_run
+        )
+        kwargs["submit_fn"] = ClusterSubmitter(
+            store, cluster, namespace=namespace
+        )
+        click.echo(f"cluster mode: kubectl -n {namespace}"
+                   + (" (dry-run)" if kube_dry_run else ""))
     click.echo(f"agent started; polling {which} (ctrl-c to stop)")
-    Agent(store=RunStore(), queues=list(queues) or None).serve(
+    Agent(store=store, queues=list(queues) or None, **kwargs).serve(
         poll_interval=poll_interval
     )
 

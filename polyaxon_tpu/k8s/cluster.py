@@ -1,0 +1,149 @@
+"""Concrete ClusterClient over `kubectl` — the last mile of the operator
+story (SURVEY.md §3 stack (d): "operator reconcile → pod conditions → CRD
+status → agent"). The reference's operator talks to the apiserver through a
+generated client; here the same three-verb contract (submit/status/delete,
+scheduler/reconciler.py) shells out to `kubectl`, which keeps auth,
+kubeconfig contexts, and API-version negotiation out of the framework.
+
+Everything is label-scoped: the converter stamps every object with
+`polyaxon/run-uuid=<uuid>`, so status and delete address the run's whole
+gang (all slices' Jobs + the headless Service) without tracking names.
+
+`dry_run=True` turns submit/delete into `--dry-run=client` validations —
+the smoke-testable mode for environments without an apiserver.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Optional
+
+RUN_LABEL = "polyaxon/run-uuid"
+
+
+class ClusterError(RuntimeError):
+    """kubectl returned non-zero; carries the command and stderr tail."""
+
+
+class KubectlCluster:
+    def __init__(
+        self,
+        namespace: str = "polyaxon",
+        *,
+        context: Optional[str] = None,
+        kubectl: str = "kubectl",
+        dry_run: bool = False,
+        timeout: float = 60.0,
+    ):
+        self.namespace = namespace
+        self.context = context
+        self.kubectl = kubectl
+        self.dry_run = dry_run
+        self.timeout = timeout
+
+    # ------------------------------------------------------------ plumbing
+    def _base(self) -> list[str]:
+        cmd = [self.kubectl, "-n", self.namespace]
+        if self.context:
+            cmd += ["--context", self.context]
+        return cmd
+
+    def _run(
+        self, args: list[str], stdin: Optional[str] = None
+    ) -> subprocess.CompletedProcess:
+        cmd = self._base() + args
+        try:
+            proc = subprocess.run(
+                cmd,
+                input=stdin,
+                capture_output=True,
+                text=True,
+                timeout=self.timeout,
+            )
+        except FileNotFoundError as e:
+            raise ClusterError(
+                f"kubectl binary not found ({self.kubectl}): {e}"
+            ) from e
+        except subprocess.TimeoutExpired as e:
+            raise ClusterError(
+                f"kubectl timed out after {self.timeout}s: {' '.join(cmd)}"
+            ) from e
+        if proc.returncode != 0:
+            raise ClusterError(
+                f"kubectl failed ({proc.returncode}): {' '.join(args[:3])}…: "
+                f"{(proc.stderr or '').strip()[-500:]}"
+            )
+        return proc
+
+    # ------------------------------------------------------------ protocol
+    def submit(self, run_uuid: str, manifests: list[dict]) -> None:
+        """`kubectl apply -f -` with a v1 List of the gang's manifests."""
+        payload = json.dumps(
+            {"apiVersion": "v1", "kind": "List", "items": manifests}
+        )
+        args = ["apply", "-f", "-"]
+        if self.dry_run:
+            args.append("--dry-run=client")
+        self._run(args, stdin=payload)
+
+    def status(self, run_uuid: str) -> dict:
+        """Pod phases for the run's gang, shaped for the Reconciler:
+        {"pods": [{"name", "phase", "reason"?, "exit_code"?}]}.
+
+        `reason` prefers the pod-level reason (where kubelet puts Evicted /
+        Preempted / NodeShutdown) and falls back to the main container's
+        terminated reason; exit_code comes from the first terminated
+        container so gang-failure handling can distinguish crash loops."""
+        if self.dry_run:
+            return {"pods": []}
+        proc = self._run(
+            [
+                "get", "pods",
+                "-l", f"{RUN_LABEL}={run_uuid}",
+                "-o", "json",
+                "--ignore-not-found",
+            ]
+        )
+        out = (proc.stdout or "").strip()
+        if not out:
+            return {"pods": []}
+        try:
+            items = json.loads(out).get("items", [])
+        except json.JSONDecodeError as e:
+            raise ClusterError(f"unparseable kubectl pod list: {e}") from e
+        pods = []
+        for item in items:
+            meta = item.get("metadata") or {}
+            st = item.get("status") or {}
+            pod: dict = {
+                "name": meta.get("name", ""),
+                "phase": st.get("phase", "Unknown"),
+            }
+            reason = st.get("reason")
+            exit_code = None
+            for cs in st.get("containerStatuses") or []:
+                term = (cs.get("state") or {}).get("terminated")
+                if term:
+                    if exit_code is None:
+                        exit_code = term.get("exitCode")
+                    reason = reason or term.get("reason")
+            if reason:
+                pod["reason"] = reason
+            if exit_code is not None:
+                pod["exit_code"] = exit_code
+            pods.append(pod)
+        return {"pods": pods}
+
+    def delete(self, run_uuid: str) -> None:
+        """Tear down the run's gang by label; `--wait=false` keeps the
+        reconcile tick non-blocking (the next tick observes the drain)."""
+        args = [
+            "delete", "job,service",
+            "-l", f"{RUN_LABEL}={run_uuid}",
+            "--ignore-not-found",
+            "--wait=false",
+        ]
+        if self.dry_run:
+            args.append("--dry-run=client")
+        self._run(args)

@@ -6,9 +6,9 @@ submits rendered manifests through a ClusterClient; the Reconciler polls
 pod phases back out and drives the run's lifecycle in the store, including
 gang-failure restarts per the spec's termination.maxRetries.
 
-The ClusterClient is injectable (the sandbox has no kubectl/apiserver):
-tests drive a FakeCluster; a real deployment implements the same three
-methods over the k8s API.
+The ClusterClient is injectable: tests drive a FakeCluster; real
+deployments use `k8s/cluster.KubectlCluster` (the three-verb contract over
+`kubectl`, wired in via `polyaxon agent start --cluster`).
 """
 
 from __future__ import annotations
@@ -58,7 +58,9 @@ class ClusterSubmitter:
     def __call__(self, compiled) -> str:
         from ..k8s.converter import convert_operation
 
-        manifests = convert_operation(compiled, self.catalog)
+        manifests = convert_operation(
+            compiled, self.catalog, namespace=self.namespace
+        )
         path = self.store.run_dir(compiled.run_uuid) / "manifests.json"
         path.write_text(json.dumps(manifests))
         self.cluster.submit(compiled.run_uuid, manifests)
@@ -114,6 +116,9 @@ class Reconciler:
         self.store = store
         self.cluster = cluster
         self.queues = set(queues) if queues is not None else None
+        # last client-fault message logged per run: a persistent outage
+        # must not append an identical line every tick
+        self._last_err: dict[str, str] = {}
 
     def _owns(self, uuid: str, status: dict) -> bool:
         """Ownership key: the ROUTED queue recorded in run meta at submit
@@ -160,52 +165,77 @@ class Reconciler:
     # --------------------------------------------------------------- tick
     def tick(self) -> list[tuple[str, str]]:
         """One reconcile pass over every active cluster-submitted run.
-        Returns [(uuid, new_status)] for runs whose status changed."""
+        Returns [(uuid, new_status)] for runs whose status changed.
+
+        Fault isolation: a cluster-client exception (apiserver flap,
+        kubectl error, malformed response) on ONE run must not stop the
+        other gangs from draining — the run keeps its current status, the
+        error lands in its log, and the next tick retries."""
         changes = []
         for rec in self.store.list_runs():
             uuid = rec["uuid"]
-            manifest_path = self.store.run_dir(uuid) / "manifests.json"
-            if not manifest_path.exists():
-                continue  # not a cluster run
-            status = self.store.get_status(uuid)
-            current = V1Statuses(status["status"])
-            stopping = current in (V1Statuses.STOPPING, V1Statuses.STOPPED)
-            if not stopping and current not in _ACTIVE:
-                continue  # terminal: skip before any ownership/spec reads
-            if not self._owns(uuid, status):
-                continue  # another agent's queue drives this gang
-            if stopping:
-                # stop propagation: tear down the gang, then settle the status
-                if self.cluster.status(uuid).get("pods"):
-                    self.cluster.delete(uuid)
-                if current == V1Statuses.STOPPING:
-                    self.store.set_status(uuid, V1Statuses.STOPPED, reason="reconciler")
-                    changes.append((uuid, V1Statuses.STOPPED))
+            try:
+                change = self._tick_one(uuid)
+                self._last_err.pop(uuid, None)
+            except Exception as e:  # client fault: skip this run, not the tick
+                msg = f"reconcile error ({type(e).__name__}): {e}"
+                if self._last_err.get(uuid) != msg:  # log transitions only
+                    self._last_err[uuid] = msg
+                    try:
+                        self.store.append_log(uuid, msg)
+                    except Exception:
+                        pass  # even logging may hit the fault; keep draining
                 continue
-            pods = self.cluster.status(uuid).get("pods", [])
-            agg = aggregate_pods(pods)
-            if agg is None or agg == current:
-                continue
-            if agg == V1Statuses.FAILED:
-                changes.append(
-                    (
-                        uuid,
-                        self._handle_failure(
-                            uuid, manifest_path, preempted=is_preemption(pods)
-                        ),
-                    )
-                )
-                continue
-            self._advance(uuid, agg, reason="reconciler")
-            changes.append((uuid, self.store.get_status(uuid)["status"]))
+            if change is not None:
+                changes.append(change)
         return changes
+
+    def _tick_one(self, uuid: str) -> Optional[tuple[str, str]]:
+        manifest_path = self.store.run_dir(uuid) / "manifests.json"
+        if not manifest_path.exists():
+            return None  # not a cluster run
+        status = self.store.get_status(uuid)
+        current = V1Statuses(status["status"])
+        stopping = current in (V1Statuses.STOPPING, V1Statuses.STOPPED)
+        if not stopping and current not in _ACTIVE:
+            return None  # terminal: skip before any ownership/spec reads
+        if not self._owns(uuid, status):
+            return None  # another agent's queue drives this gang
+        if stopping:
+            # stop propagation: tear down the gang, then settle the status
+            if (self.cluster.status(uuid) or {}).get("pods"):
+                self.cluster.delete(uuid)
+            if current == V1Statuses.STOPPING:
+                self.store.set_status(uuid, V1Statuses.STOPPED, reason="reconciler")
+                return (uuid, V1Statuses.STOPPED)
+            return None
+        if (status.get("meta") or {}).get("resubmit_pending"):
+            return self._try_resubmit(uuid, manifest_path)
+        pods = (self.cluster.status(uuid) or {}).get("pods") or []
+        agg = aggregate_pods(pods)
+        if agg is None or agg == current:
+            return None
+        if agg == V1Statuses.FAILED:
+            return (
+                uuid,
+                self._handle_failure(
+                    uuid, manifest_path, preempted=is_preemption(pods)
+                ),
+            )
+        self._advance(uuid, agg, reason="reconciler")
+        return (uuid, self.store.get_status(uuid)["status"])
 
     def _handle_failure(self, uuid: str, manifest_path, preempted: bool = False) -> str:
         """Gang restart per termination.maxRetries: delete the failed
-        objects, resubmit the persisted manifests, walk the lifecycle back
-        through RETRYING→QUEUED→SCHEDULED. Preemptions (spot slice taken
-        away) always restart and never consume the retry budget — the run
-        resumes from its last checkpoint."""
+        objects and walk the lifecycle back through RETRYING→QUEUED.
+        Preemptions (spot slice taken away) always restart and never
+        consume the retry budget — the run resumes from its checkpoint.
+
+        The resubmit is DEFERRED to a later tick: a real cluster's delete
+        is asynchronous (kubectl --wait=false), so applying the same
+        manifests in the same tick would land on the still-terminating
+        objects and the restarted gang would silently never exist. The
+        next tick resubmits once the old gang's pods are gone."""
         attempts = self._attempts(uuid)
         if preempted or attempts < self._max_retries(uuid):
             if not preempted:
@@ -216,14 +246,27 @@ class Reconciler:
                 if preempted
                 else f"gang restart {attempts + 1}"
             )
-            for s in (V1Statuses.RETRYING, V1Statuses.QUEUED, V1Statuses.SCHEDULED):
+            for s in (V1Statuses.RETRYING, V1Statuses.QUEUED):
                 current = V1Statuses(self.store.get_status(uuid)["status"])
                 if current != s and can_transition(current, s):
                     self.store.set_status(uuid, s, reason=reason)
-            self.cluster.submit(uuid, json.loads(manifest_path.read_text()))
+            self.store.set_meta(uuid, resubmit_pending=1)
             return self.store.get_status(uuid)["status"]
         self._advance(uuid, V1Statuses.FAILED, reason="pod failed")
         return self.store.get_status(uuid)["status"]
+
+    def _try_resubmit(self, uuid: str, manifest_path) -> Optional[tuple[str, str]]:
+        """Second half of a gang restart: wait for the old gang to drain,
+        then re-apply the persisted manifests."""
+        if (self.cluster.status(uuid) or {}).get("pods"):
+            return None  # old gang still terminating
+        self.cluster.submit(uuid, json.loads(manifest_path.read_text()))
+        self.store.set_meta(uuid, resubmit_pending=0)
+        for s in (V1Statuses.QUEUED, V1Statuses.SCHEDULED):
+            current = V1Statuses(self.store.get_status(uuid)["status"])
+            if current != s and can_transition(current, s):
+                self.store.set_status(uuid, s, reason="gang resubmitted")
+        return (uuid, self.store.get_status(uuid)["status"])
 
     def watch(self, poll_interval: float = 2.0, stop_when=lambda: False):
         import time

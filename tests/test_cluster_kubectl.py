@@ -1,0 +1,227 @@
+"""KubectlCluster: the concrete ClusterClient over `kubectl` (k8s/cluster.py)
+driven against a recording stub binary (the sandbox has no apiserver), plus
+reconciler fault isolation when the client misbehaves mid-tick."""
+
+import json
+import os
+import stat
+
+import pytest
+import yaml
+
+from polyaxon_tpu.k8s.cluster import ClusterError, KubectlCluster
+from polyaxon_tpu.scheduler.reconciler import Reconciler
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.store.local import RunStore
+
+from tests.test_reconciler import SPEC, FakeCluster, _submit
+
+
+STUB = """#!/bin/bash
+# recording kubectl stub: logs argv + stdin, replays canned output
+dir="$(dirname "$0")"
+printf '%s\\n' "$@" > "$dir/last_args"
+cat > "$dir/last_stdin"
+if [ -f "$dir/exit_code" ]; then rc=$(cat "$dir/exit_code"); else rc=0; fi
+if [ "$rc" != 0 ]; then echo "stub error text" >&2; exit "$rc"; fi
+if [ -f "$dir/stdout" ]; then cat "$dir/stdout"; fi
+"""
+
+
+@pytest.fixture
+def stub_kubectl(tmp_path):
+    path = tmp_path / "kubectl"
+    path.write_text(STUB)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return path
+
+
+def _args(stub):
+    return (stub.parent / "last_args").read_text().splitlines()
+
+
+def test_submit_applies_manifest_list(stub_kubectl):
+    c = KubectlCluster(namespace="ns1", kubectl=str(stub_kubectl))
+    c.submit("u1", [{"kind": "Job"}, {"kind": "Service"}])
+    args = _args(stub_kubectl)
+    assert args[:2] == ["-n", "ns1"]
+    assert "apply" in args and "-f" in args and "--dry-run=client" not in args
+    sent = json.loads((stub_kubectl.parent / "last_stdin").read_text())
+    assert sent["kind"] == "List" and len(sent["items"]) == 2
+
+
+def test_submit_dry_run_flag(stub_kubectl):
+    c = KubectlCluster(kubectl=str(stub_kubectl), dry_run=True)
+    c.submit("u1", [{"kind": "Job"}])
+    assert "--dry-run=client" in _args(stub_kubectl)
+
+
+def test_status_parses_pod_list(stub_kubectl):
+    (stub_kubectl.parent / "stdout").write_text(
+        json.dumps(
+            {
+                "items": [
+                    {
+                        "metadata": {"name": "w-0"},
+                        "status": {"phase": "Running"},
+                    },
+                    {
+                        "metadata": {"name": "w-1"},
+                        "status": {
+                            "phase": "Failed",
+                            "reason": "Evicted",
+                            "containerStatuses": [
+                                {
+                                    "state": {
+                                        "terminated": {
+                                            "exitCode": 137,
+                                            "reason": "OOMKilled",
+                                        }
+                                    }
+                                }
+                            ],
+                        },
+                    },
+                    {"metadata": {"name": "w-2"}, "status": {}},  # partial
+                ]
+            }
+        )
+    )
+    c = KubectlCluster(kubectl=str(stub_kubectl))
+    st = c.status("u1")
+    args = _args(stub_kubectl)
+    assert "polyaxon/run-uuid=u1" in args
+    assert st["pods"][0] == {"name": "w-0", "phase": "Running"}
+    # pod-level reason (Evicted) wins over the container's OOMKilled —
+    # preemption classification depends on it
+    assert st["pods"][1]["reason"] == "Evicted"
+    assert st["pods"][1]["exit_code"] == 137
+    assert st["pods"][2]["phase"] == "Unknown"  # partial status, no crash
+
+
+def test_status_empty_output_means_no_pods(stub_kubectl):
+    c = KubectlCluster(kubectl=str(stub_kubectl))
+    assert c.status("nope") == {"pods": []}
+
+
+def test_delete_is_label_scoped_and_nonblocking(stub_kubectl):
+    c = KubectlCluster(kubectl=str(stub_kubectl))
+    c.delete("u9")
+    args = _args(stub_kubectl)
+    assert "job,service" in args
+    assert "polyaxon/run-uuid=u9" in args
+    assert "--wait=false" in args
+
+
+def test_kubectl_failure_raises_cluster_error(stub_kubectl):
+    (stub_kubectl.parent / "exit_code").write_text("1")
+    c = KubectlCluster(kubectl=str(stub_kubectl))
+    with pytest.raises(ClusterError, match="stub error text"):
+        c.submit("u1", [])
+
+
+def test_missing_binary_raises_cluster_error():
+    c = KubectlCluster(kubectl="/nonexistent/kubectl")
+    with pytest.raises(ClusterError, match="not found"):
+        c.status("u1")
+
+
+# ---------------------------------------------------- reconciler hardening
+class FlakyCluster(FakeCluster):
+    """status() raises for selected runs — an apiserver flap mid-drain."""
+
+    def __init__(self):
+        super().__init__()
+        self.broken: set[str] = set()
+
+    def status(self, run_uuid):
+        if run_uuid in self.broken:
+            raise ClusterError("apiserver 503")
+        return super().status(run_uuid)
+
+
+def test_reconciler_isolates_client_faults(tmp_home, tmp_path):
+    """One run's client exception must not stop other gangs from draining."""
+    store = RunStore()
+    cluster = FlakyCluster()
+    u_bad = _submit(tmp_path, store, cluster)
+    u_good = _submit(tmp_path, store, cluster)
+    cluster.broken.add(u_bad)
+    cluster.set_all(u_good, "Running")
+
+    rec = Reconciler(store, cluster)
+    changes = rec.tick()
+    assert (u_good, V1Statuses.RUNNING) in changes
+    # the broken run kept its pre-fault status and logged the error
+    assert store.get_status(u_bad)["status"] == V1Statuses.SCHEDULED
+    assert "reconcile error" in store.read_logs(u_bad)
+
+    # flap heals -> next tick picks the run back up
+    cluster.broken.clear()
+    cluster.set_all(u_bad, "Running")
+    changes = rec.tick()
+    assert (u_bad, V1Statuses.RUNNING) in changes
+
+
+def test_reconciler_tolerates_malformed_status(tmp_home, tmp_path):
+    """None / pod dicts with missing keys must not crash the tick."""
+    store = RunStore()
+    cluster = FakeCluster()
+    uuid = _submit(tmp_path, store, cluster)
+
+    class WeirdCluster(FakeCluster):
+        def status(self, run_uuid):
+            return None  # a client returning nothing at all
+
+    rec = Reconciler(store, WeirdCluster())
+    assert rec.tick() == []  # no crash, no change
+
+    cluster.pods[uuid] = [{"no_phase_key": True}, {"phase": "Running"}]
+    rec = Reconciler(store, cluster)
+    changes = rec.tick()
+    assert (uuid, V1Statuses.RUNNING) in changes
+
+
+class AsyncDeleteCluster(FakeCluster):
+    """delete returns immediately while pods linger Terminating — the real
+    `kubectl delete --wait=false` behavior a gang restart must survive."""
+
+    def __init__(self):
+        super().__init__()
+        self.submit_calls = 0
+
+    def submit(self, run_uuid, manifests):
+        self.submit_calls += 1
+        super().submit(run_uuid, manifests)
+
+    def delete(self, run_uuid):
+        self.deleted.append(run_uuid)  # pods NOT removed yet
+
+    def drain(self, run_uuid):
+        self.pods.pop(run_uuid, None)
+
+
+def test_gang_restart_waits_for_async_delete(tmp_home, tmp_path):
+    """Resubmit must be deferred until the old gang's pods are gone;
+    resubmitting into a terminating gang silently loses the restart."""
+    store = RunStore()
+    cluster = AsyncDeleteCluster()
+    uuid = _submit(tmp_path, store, cluster)
+    rec = Reconciler(store, cluster)
+
+    cluster.set_all(uuid, "Running")
+    rec.tick()
+    cluster.pods[uuid][0]["phase"] = "Failed"
+    assert rec.tick() == [(uuid, V1Statuses.QUEUED)]
+    assert cluster.deleted == [uuid]
+    submits_before = cluster.submit_calls
+
+    # old pods still draining: no resubmit, no double-delete, no re-count
+    assert rec.tick() == []
+    assert rec.tick() == []
+    assert cluster.submit_calls == submits_before
+
+    cluster.drain(uuid)  # k8s finishes the delete
+    assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)]
+    assert cluster.submit_calls == submits_before + 1
+    assert all(p["phase"] == "Pending" for p in cluster.pods[uuid])

@@ -268,6 +268,7 @@ def test_distributed_multislice_gang(tmp_home, tmp_path):
     }
     p = tmp_path / "dist_multislice.yaml"
     p.write_text(yaml.safe_dump(spec))
+    prev = os.environ.get("JAX_NUM_CPU_DEVICES")
     os.environ["JAX_NUM_CPU_DEVICES"] = "2"  # 2 devices/proc -> 4 global
     try:
         store = RunStore()
@@ -278,4 +279,7 @@ def test_distributed_multislice_gang(tmp_home, tmp_path):
         metrics = store.read_metrics(compiled.run_uuid)
         assert metrics and metrics[-1]["step"] == 2
     finally:
-        os.environ.pop("JAX_NUM_CPU_DEVICES", None)
+        if prev is None:
+            os.environ.pop("JAX_NUM_CPU_DEVICES", None)
+        else:
+            os.environ["JAX_NUM_CPU_DEVICES"] = prev

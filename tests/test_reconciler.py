@@ -114,9 +114,11 @@ def test_gang_failure_restarts_then_fails(tmp_home, tmp_path):
     rec.tick()
     cluster.pods[uuid][0]["phase"] = "Failed"  # one worker dies
 
-    # maxRetries=1 → first failure: delete + resubmit, back to SCHEDULED
-    assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)]
+    # maxRetries=1 → first failure: delete + QUEUED; the resubmit is
+    # deferred to the next tick (real deletes are asynchronous)
+    assert rec.tick() == [(uuid, V1Statuses.QUEUED)]
     assert cluster.deleted == [uuid]
+    assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)]
     assert all(p["phase"] == "Pending" for p in cluster.pods[uuid])
     types = [c["type"] for c in store.get_status(uuid)["conditions"]]
     assert "retrying" in types
@@ -141,6 +143,7 @@ def test_preemption_restarts_without_burning_retries(tmp_home, tmp_path):
         rec.tick()
         for p in cluster.pods[uuid]:
             p["phase"], p["reason"] = "Failed", "Preempted"
+        assert rec.tick() == [(uuid, V1Statuses.QUEUED)], f"round {round_}"
         assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)], f"round {round_}"
     meta = store.get_status(uuid).get("meta", {})
     assert int(meta.get("cluster_attempts") or 0) == 0  # budget untouched
@@ -149,6 +152,7 @@ def test_preemption_restarts_without_burning_retries(tmp_home, tmp_path):
     cluster.set_all(uuid, "Running")
     rec.tick()
     cluster.pods[uuid][0].update(phase="Failed", reason="Error")
+    assert rec.tick() == [(uuid, V1Statuses.QUEUED)]
     assert rec.tick() == [(uuid, V1Statuses.SCHEDULED)]
     cluster.set_all(uuid, "Running")
     rec.tick()
