@@ -120,6 +120,9 @@ def token_file(batch_size, config, seed, process_index, process_count=1):
         iterator=iterator,
         batch_size=batch_size,
         meta=meta,
+        # native loaders own worker threads + a corpus mmap; release them
+        # when the run tears down, not at interpreter GC
+        close=getattr(iterator, "close", None),
     )
 
 

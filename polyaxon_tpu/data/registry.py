@@ -11,12 +11,21 @@ _DATASETS: dict[str, Callable[..., "DataSpec"]] = {}
 @dataclasses.dataclass
 class DataSpec:
     """A built pipeline: `iterator` yields dict batches forever; `batch_size`
-    is the per-host batch (global batch / process_count)."""
+    is the per-host batch (global batch / process_count). `close` releases
+    pipeline resources deterministically (native prefetch threads, corpus
+    mmaps) — long-lived agent processes must not rely on GC-time __del__."""
 
     name: str
     iterator: Iterator[dict[str, Any]]
     batch_size: int
     meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+    close: Optional[Callable[[], None]] = None
+
+    def shutdown(self) -> None:
+        """Idempotent teardown hook (trainer/executor call this)."""
+        fn, self.close = self.close, None
+        if fn is not None:
+            fn()
 
 
 def register_dataset(name: str):

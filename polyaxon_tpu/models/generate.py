@@ -156,12 +156,16 @@ def beam_search(
     keeps the top `num_beams` continuations, and reorders the KV cache by
     each survivor's parent beam (a batch-dim gather on the cache pytree).
 
-    Scoring follows the canonical recipe: mid-scan pruning ranks beams by
-    RAW accumulated log-prob (a finished beam can be evicted by higher-raw
-    live beams — no separate finished-hypothesis buffer is kept), and
-    `length_penalty` applies only to the FINAL ranking among the nb
-    survivors (dividing by length**length_penalty; >1 favors longer).
-    With `eos_id`, finished beams freeze: forced eos, no score change."""
+    Scoring follows the canonical (HF-style) recipe. Without `eos_id`,
+    mid-scan pruning ranks beams by RAW accumulated log-prob and
+    `length_penalty` applies only to the FINAL ranking (dividing by
+    length**length_penalty; >1 favors longer). With `eos_id`, each step
+    expands the top 2*nb candidates: those ending in eos move into a
+    FINISHED-HYPOTHESIS buffer (ranked by length-penalized score, worst
+    evicted), the best nb non-eos candidates stay live — so a short
+    finished hypothesis the final ranking would prefer can never be
+    evicted by a live beam's raw score. The final answer is the best of
+    {finished buffer, live beams} under the length penalty."""
     cfg = module.cfg
     B, P = prompt.shape
     total = P + int(max_new_tokens)
@@ -220,8 +224,34 @@ def beam_search(
         logits[:, -1].astype(jnp.float32), axis=-1
     )  # [B, V]
     V = first_logp.shape[-1]
-    # first expansion: row's beams take the top-nb distinct first tokens
-    scores0, tok0 = jax.lax.top_k(first_logp, nb)  # [B, nb]
+    lp = float(length_penalty)
+    if eos_id is None:
+        # first expansion: row's beams take the top-nb distinct first tokens
+        scores0, tok0 = jax.lax.top_k(first_logp, nb)  # [B, nb]
+    else:
+        # expand 2*nb so that after eos candidates leave for the finished
+        # buffer at least nb live continuations remain (eos appears at most
+        # once per parent, so <= nb of the 2*nb candidates are eos)
+        k0 = min(2 * nb, V)
+        sc2, tok2 = jax.lax.top_k(first_logp, k0)  # [B, k0]
+        is_eos0 = tok2 == eos_id
+        scores0, pick0 = jax.lax.top_k(
+            jnp.where(is_eos0, -jnp.inf, sc2), nb
+        )
+        tok0 = jnp.take_along_axis(tok2, pick0, axis=1)  # [B, nb] live
+        # finished buffer: [B, nb] penalized scores + full sequences; the
+        # first-step eos hypotheses have generated length 1
+        fin_scores = jax.lax.top_k(
+            jnp.where(is_eos0, sc2, -jnp.inf), min(nb, k0)
+        )[0]
+        if fin_scores.shape[1] < nb:  # pad (top_k k0 < nb can't happen; safety)
+            fin_scores = jnp.pad(
+                fin_scores, ((0, 0), (0, nb - fin_scores.shape[1])),
+                constant_values=-jnp.inf,
+            )
+        fin_buf = jnp.zeros((B, nb, total), jnp.int32)
+        fin_buf = fin_buf.at[:, :, :P].set(prompt[:, None, :])
+        fin_buf = fin_buf.at[:, :, P].set(eos_id)
 
     buf = jnp.zeros((BN, total), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, tile(prompt), (0, 0))
@@ -236,8 +266,9 @@ def beam_search(
             lambda c: gather_rows(c, flat, cache_batch_axis), tree
         )
 
-    def step(carry, t):
-        cache, buf, scores, done = carry  # scores/done: [B, nb]
+    def expand(cache, buf, scores, t):
+        """Shared per-step expansion: feed position t, return candidate
+        log-probs and the updated cache."""
         tok = jax.lax.dynamic_slice(buf, (0, t), (BN, 1))
         logits, out_vars = module.apply(
             {"params": params, "cache": cache},
@@ -249,43 +280,90 @@ def beam_search(
         logp = jax.nn.log_softmax(
             logits[:, -1].astype(jnp.float32), axis=-1
         ).reshape(B, nb, V)
-        if eos_id is not None:
-            done = done | (tok.reshape(B, nb) == eos_id)
-            # a finished beam only continues as eos, at no score change
-            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
-            logp = jnp.where(done[:, :, None], frozen[None, None, :], logp)
-        cand = scores[:, :, None] + logp  # [B, nb, V]
-        scores, idx = jax.lax.top_k(cand.reshape(B, nb * V), nb)
-        parent, nxt = idx // V, (idx % V).astype(jnp.int32)  # [B, nb]
+        return scores[:, :, None] + logp, out_vars["cache"]  # [B, nb, V]
+
+    def keep_live(cache, buf, parent, nxt, t):
         flat = (jnp.arange(B)[:, None] * nb + parent).reshape(BN)
-        cache = gather_beams_cache(out_vars["cache"], parent)
+        cache = gather_beams_cache(cache, parent)
         buf = buf[flat]
-        done = jnp.take_along_axis(done, parent, axis=1)
-        buf = jax.lax.dynamic_update_slice(
+        return cache, jax.lax.dynamic_update_slice(
             buf, nxt.reshape(BN, 1), (0, t + 1)
         )
-        return (cache, buf, scores, done), None
 
-    done0 = (
-        (tok0 == eos_id) if eos_id is not None else jnp.zeros((B, nb), bool)
-    )
-    carry = (cache0, buf, scores0, done0)
-    if max_new_tokens > 1:
-        carry, _ = jax.lax.scan(step, carry, jnp.arange(P, total - 1))
-    _, buf, scores, done = carry
+    def step_raw(carry, t):
+        """No eos: canonical raw-score pruning over nb*V candidates."""
+        cache, buf, scores = carry
+        cand, cache = expand(cache, buf, scores, t)
+        scores, idx = jax.lax.top_k(cand.reshape(B, nb * V), nb)
+        parent, nxt = idx // V, (idx % V).astype(jnp.int32)  # [B, nb]
+        cache, buf = keep_live(cache, buf, parent, nxt, t)
+        return (cache, buf, scores), None
 
-    # length-normalized selection: a beam's generated length is max_new for
-    # unfinished beams, or its first-eos offset for finished ones
-    out = buf.reshape(B, nb, total)
-    gen = out[:, :, P:]
-    if eos_id is not None:
-        is_eos = gen == eos_id
-        first_eos = jnp.where(
-            is_eos.any(-1), jnp.argmax(is_eos, -1) + 1, max_new_tokens
+    def step_eos(carry, t):
+        """With eos: top 2*nb candidates; eos continuations move into the
+        finished buffer (length-penalized, worst evicted), the best nb
+        non-eos candidates stay live."""
+        cache, buf, scores, fin_scores, fin_buf = carry
+        cand, cache = expand(cache, buf, scores, t)
+        k = min(2 * nb, nb * V)
+        cand_sc, idx = jax.lax.top_k(cand.reshape(B, nb * V), k)  # [B, k]
+        parent, nxt = idx // V, (idx % V).astype(jnp.int32)
+        is_eos = nxt == eos_id
+
+        # candidate sequences [B, k, total]: parent's buffer + new token
+        parent_buf = jnp.take_along_axis(
+            buf.reshape(B, nb, total), parent[:, :, None], axis=1
         )
-        lengths = first_eos.astype(jnp.float32)
-    else:
-        lengths = jnp.full((B, nb), float(max_new_tokens))
-    final = scores / (lengths ** float(length_penalty))
-    best = jnp.argmax(final, axis=1)
-    return jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+        cand_buf = jax.lax.dynamic_update_slice_in_dim(
+            parent_buf, nxt[:, :, None], t + 1, axis=2
+        )
+        # finished insertion: generated length includes this eos token
+        gen_len = (t + 2 - P).astype(jnp.float32)
+        pen = jnp.where(is_eos, cand_sc / gen_len**lp, -jnp.inf)
+        all_sc = jnp.concatenate([fin_scores, pen], axis=1)  # [B, nb+k]
+        all_buf = jnp.concatenate([fin_buf, cand_buf], axis=1)
+        fin_scores, fidx = jax.lax.top_k(all_sc, nb)
+        fin_buf = jnp.take_along_axis(all_buf, fidx[:, :, None], axis=1)
+
+        # live continuation: best nb non-eos candidates
+        scores, pick = jax.lax.top_k(
+            jnp.where(is_eos, -jnp.inf, cand_sc), nb
+        )
+        parent = jnp.take_along_axis(parent, pick, axis=1)
+        nxt = jnp.take_along_axis(nxt, pick, axis=1)
+        cache, buf = keep_live(cache, buf, parent, nxt, t)
+        return (cache, buf, scores, fin_scores, fin_buf), None
+
+    if eos_id is None:
+        carry = (cache0, buf, scores0)
+        if max_new_tokens > 1:
+            carry, _ = jax.lax.scan(
+                step_raw, carry, jnp.arange(P, total - 1)
+            )
+        _, buf, scores = carry
+        out = buf.reshape(B, nb, total)
+        final = scores / (float(max_new_tokens) ** lp)
+        best = jnp.argmax(final, axis=1)
+        return jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+
+    carry = (cache0, buf, scores0, fin_scores, fin_buf)
+    if max_new_tokens > 1:
+        carry, _ = jax.lax.scan(step_eos, carry, jnp.arange(P, total - 1))
+    _, buf, scores, fin_scores, fin_buf = carry
+    # final ranking: live beams (never eos-ended → full length) against the
+    # finished buffer (already length-penalized)
+    live_pen = scores / (float(max_new_tokens) ** lp)
+    all_sc = jnp.concatenate([live_pen, fin_scores], axis=1)  # [B, 2nb]
+    all_buf = jnp.concatenate(
+        [buf.reshape(B, nb, total), fin_buf], axis=1
+    )
+    best = jnp.argmax(all_sc, axis=1)
+    sel = jnp.take_along_axis(all_buf, best[:, None, None], axis=1)[:, 0]
+    # finished buffers carry stale parent tokens after their eos — pad with
+    # eos like generate() does so callers can truncate uniformly
+    gen = sel[:, P:]
+    seen = jnp.cumsum(gen == eos_id, axis=1) > 0
+    after = jnp.concatenate(
+        [jnp.zeros((B, 1), bool), seen[:, :-1]], axis=1
+    )
+    return sel.at[:, P:].set(jnp.where(after, eos_id, gen))

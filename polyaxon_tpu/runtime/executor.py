@@ -554,7 +554,10 @@ class Executor:
             artifacts_dir=str(store.outputs_dir(run_uuid)),
         )
         store.set_status(run_uuid, V1Statuses.RUNNING)
-        result = trainer.run()
+        try:
+            result = trainer.run()
+        finally:
+            trainer.close()
         store.log_event(
             run_uuid,
             "run_summary",

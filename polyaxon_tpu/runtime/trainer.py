@@ -74,6 +74,36 @@ def _cast_floats(tree, dtype):
     )
 
 
+def param_dtype_for(precision: str):
+    """Master-weight dtype for a train.precision setting."""
+    return jnp.bfloat16 if precision == "bfloat16" else jnp.float32
+
+
+def make_param_init(bundle, param_dtype, example):
+    """The init-and-cast recipe for a bundle's params + mutable collections.
+
+    Shared between training setup (_build_step) and the serving restore
+    (serving/server.from_run): serving rebuilds the ABSTRACT param tree
+    from the stored spec to partial-restore a checkpoint, and the two code
+    paths must produce identical trees or the restore breaks — one
+    function, no drift. Params do not depend on the example's batch dim,
+    so any batch size works for shape inference."""
+
+    def init_fn(rng):
+        variables = bundle.module.init(
+            {"params": rng, **{k: rng for k in bundle.rngs}},
+            example,
+            train=False,
+        )
+        params = variables["params"]
+        if param_dtype != jnp.float32:
+            params = _cast_floats(params, param_dtype)
+        extra = {k: variables[k] for k in tuple(bundle.mutable)}
+        return params, extra
+
+    return init_fn
+
+
 class Trainer:
     """Drives one program on one mesh. Multi-host setup (jax.distributed)
     happens in the executor before this class is built."""
@@ -129,9 +159,7 @@ class Trainer:
 
         set_current_mesh(self.mesh)
         self.compute_dtype = _compute_dtype(tspec.precision)
-        self.param_dtype = (
-            jnp.bfloat16 if tspec.precision == "bfloat16" else jnp.float32
-        )
+        self.param_dtype = param_dtype_for(tspec.precision)
         self._build_step()
 
     def _validate_mesh_fit(self):
@@ -210,19 +238,7 @@ class Trainer:
         init_rng = jax.random.PRNGKey(int(tspec.seed))
 
         mutable = tuple(bundle.mutable)
-
-        def init_fn(rng):
-            variables = bundle.module.init(
-                {"params": rng, **{k: rng for k in bundle.rngs}},
-                example,
-                train=False,
-            )
-            params = variables["params"]
-            if self.param_dtype != jnp.float32:
-                params = _cast_floats(params, self.param_dtype)
-            extra = {k: variables[k] for k in mutable}
-            return params, extra
-
+        init_fn = make_param_init(bundle, self.param_dtype, example)
         abstract_params, abstract_extra = jax.eval_shape(init_fn, init_rng)
         if bundle.trainable_patterns:
             # LoRA-style fine-tune: non-matching params get zero updates.
@@ -579,6 +595,15 @@ class Trainer:
         vals = {k: float(v) for k, v in metrics.items()}
         history.append({"step": step, **vals})
         self.log_fn(step, vals)
+
+    def close(self):
+        """Release data-pipeline resources (native prefetch threads, corpus
+        mmaps) deterministically. Long-lived agent processes run many
+        trainers; GC-time __del__ on the native loader is best-effort and
+        can outlive the run — the executor/worker call this on teardown."""
+        self.data.shutdown()
+        if hasattr(self, "_eval_data"):
+            self._eval_data.shutdown()
 
     # -------------------------------------------------------------- ckpt
     def _ckpt_keep(self) -> Optional[int]:

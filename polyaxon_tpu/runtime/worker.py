@@ -80,7 +80,10 @@ def main() -> int:
         # all processes participate in (multi-host) checkpointing
         checkpoint_dir=payload.get("checkpointDir"),
     )
-    result = trainer.run()
+    try:
+        result = trainer.run()
+    finally:
+        trainer.close()
     if is_chief and store is not None:
         store.log_event(
             run_uuid,

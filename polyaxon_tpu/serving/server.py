@@ -31,6 +31,42 @@ from typing import Optional
 from ..store.local import RunStore
 
 
+def _restore_params_subtree(ckpt_dir: str, abstract_params):
+    """Read ONLY the params subtree of a saved TrainState (Orbax partial
+    restore) into the shardings carried by `abstract_params`.
+
+    Uses a fresh read-only CheckpointManager rather than the runtime's
+    per-directory cache (runtime/checkpoint.py): the cached manager's
+    handler registry is pinned to Standard save/restore by training, and a
+    serving process must not pin retention options for a trainer that may
+    later resume in-process."""
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(ckpt_dir)
+    try:
+        step = mgr.latest_step()
+        if step is None:
+            raise ServingError(f"no restorable checkpoint in {ckpt_dir}")
+        out = mgr.restore(
+            step,
+            args=ocp.args.PyTreeRestore(
+                {"params": abstract_params},
+                # explicit restore args: arrays land on THIS topology's
+                # shardings (serving mesh), not the sharding recorded at
+                # save time — train-on-8-hosts/serve-on-1 must work
+                restore_args={
+                    "params": ocp.checkpoint_utils.construct_restore_args(
+                        abstract_params
+                    )
+                },
+                partial_restore=True,
+            ),
+        )
+        return out["params"], step
+    finally:
+        mgr.close()
+
+
 class ServingError(RuntimeError):
     pass
 
@@ -119,15 +155,25 @@ class ModelServer:
     ):
         """Restore the latest checkpoint of a `transformer_lm` jaxjob run.
 
-        Rebuilds the trainer from the run's stored spec (same code path the
-        executor used), restores TrainState, and serves its params.
+        Serving-shaped restore — NOT a Trainer: the model bundle and mesh
+        are built directly from the stored spec, and only the `params`
+        subtree of the saved TrainState is read back (Orbax partial
+        restore). No data pipeline is constructed (the training corpus
+        need not exist on the serving host, no prefetch threads spin up)
+        and the Adam moments never touch HBM, so serving holds params-sized
+        memory instead of the ~3x TrainState.
+
         `mesh_axes` (e.g. {"model": 4}) shards the restored params over a
         device mesh for models too big for one chip — decode is unchanged,
         XLA inserts the collectives from the param shardings (parity with
         single-device decoding is tested)."""
         import jax
 
-        from ..runtime.trainer import Trainer
+        from ..models import build_model
+        from ..parallel.mesh import build_mesh
+        from ..parallel.ring import set_current_mesh
+        from ..parallel.sharding import param_shardings
+        from ..runtime.trainer import make_param_init, param_dtype_for
         from ..schemas.run_kinds import V1JAXJob
 
         store = store or RunStore()
@@ -151,18 +197,33 @@ class ModelServer:
                 f"run {uuid[:8]} has no checkpoints under its outputs — "
                 "train with train.checkpointEvery set"
             )
-        trainer = Trainer(
-            program,
-            mesh_axes=mesh_axes,
-            devices=None if mesh_axes else [jax.devices()[0]],
-            checkpoint_dir=str(ckpt_dir),
+        bundle = build_model(program.model.name, program.model.config)
+        tspec = program.train
+        seed = int(tspec.seed) if tspec else 0
+        precision = tspec.precision if tspec else "mixed"
+        mesh = build_mesh(
+            mesh_axes, devices=None if mesh_axes else [jax.devices()[0]]
         )
-        step = trainer.restore()
-        if step == 0:
-            raise ServingError(f"no restorable checkpoint in {ckpt_dir}")
+        set_current_mesh(mesh)  # decode-time sharding constraints need it
+        # the trainer's own init recipe → identical abstract tree, no drift
+        init_fn = make_param_init(
+            bundle, param_dtype_for(precision), bundle.example_inputs(1)
+        )
+        abstract_params, _ = jax.eval_shape(
+            init_fn, jax.random.PRNGKey(seed)
+        )
+        p_shard = param_shardings(
+            abstract_params, bundle.sharding_rules, mesh
+        )
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            abstract_params,
+            p_shard,
+        )
+        params, step = _restore_params_subtree(str(ckpt_dir), abstract)
         return cls(
-            trainer.bundle.module,
-            trainer.state.params,
+            bundle.module,
+            params,
             model_name=program.model.name,
             step=step,
         )

@@ -92,3 +92,29 @@ def test_array_file_classification_end_to_end(tmp_home, tmp_path):
     result = Trainer(program, mesh_axes={"data": -1}).run()
     assert result.history[-1]["loss"] < 0.3
     assert result.history[-1]["accuracy"] > 0.9
+
+
+def test_dataspec_close_releases_native_loader(tmp_path):
+    """DataSpec.shutdown() closes the native loader deterministically —
+    the executor/trainer teardown path, not GC-time __del__ (ADVICE r3)."""
+    import numpy as np
+
+    from polyaxon_tpu.data import build_data
+
+    corpus = np.arange(4096, dtype=np.uint16)
+    p = tmp_path / "corpus.bin"
+    corpus.tofile(p)
+    spec = build_data(
+        "token_file", 4,
+        {"path": str(p), "seq_len": 16, "loader": "native"},
+    )
+    assert spec.meta["loader"] == "native"
+    assert spec.close is not None
+    batch = next(spec.iterator)
+    assert batch["inputs"].shape == (4, 16)
+    spec.shutdown()
+    spec.shutdown()  # idempotent
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="closed"):
+        next(spec.iterator)

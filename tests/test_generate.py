@@ -198,3 +198,45 @@ def test_beam_search_beats_or_ties_greedy_logprob():
 
     for r in range(g.shape[0]):
         assert seq_logprob(b4[r]) >= seq_logprob(g[r]) - 1e-4
+
+
+def test_beam_search_keeps_finished_hypotheses():
+    """A hypothesis that ends in eos must survive in the finished buffer
+    even when every live beam out-ranks it on RAW score mid-scan: here the
+    eos continuation is never in the raw top-nb at its step, but the live
+    paths decay steeply afterwards, so the final length-penalized ranking
+    prefers the short finished sequence. The old freeze-in-live scheme
+    evicted it at creation and returned a much worse sequence."""
+    from types import SimpleNamespace
+
+    from polyaxon_tpu.models.generate import beam_search
+
+    V, EOS = 6, 5
+    # rows are true distributions (log_softmax leaves them unchanged up to
+    # the tiny -20 mass). Raw scores: the eos continuation [1, eos] lands
+    # at -1.45, below BOTH live candidates at its step (-1.25, -1.43), so
+    # raw pruning would drop it — but every live path then pays ~0.69 per
+    # extra token and finishes near -4, so the finished hyp must win.
+    t = np.full((V, V), -20.0, np.float32)
+    t[0, 1], t[0, 2] = np.log(0.52), np.log(0.48)
+    t[1, EOS], t[1, 2] = np.log(0.45), np.log(0.55)
+    t[2, 3], t[2, 4] = np.log(0.5), np.log(0.5)
+    t[3, 3], t[3, 4] = np.log(0.5), np.log(0.5)
+    t[4, 3], t[4, 4] = np.log(0.5), np.log(0.5)
+    table = jnp.asarray(t)
+
+    class TableLM:
+        cfg = SimpleNamespace(vocab_size=V, seq_len=16, scan_layers=False)
+
+        def apply(self, variables, tokens, train=False, decode=False,
+                  mutable=None):
+            logits = table[tokens]
+            cache = {"cached_key": jnp.zeros((tokens.shape[0], 1, 1, 1))}
+            return (logits, {"cache": cache}) if mutable else logits
+
+    prompt = jnp.zeros((1, 1), jnp.int32)
+    out = np.asarray(
+        beam_search(TableLM(), {}, prompt, max_new_tokens=6, num_beams=2,
+                    length_penalty=0.0, eos_id=EOS)
+    )
+    assert out[0, 1] == 1 and out[0, 2] == EOS, out

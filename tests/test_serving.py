@@ -184,3 +184,24 @@ def test_mesh_sharded_serving_over_http(tmp_home, tmp_path):
     finally:
         sharded.stop()
     assert out["tokens"] == ref["tokens"]
+
+
+@pytest.mark.slow
+def test_from_run_needs_no_data_pipeline(tmp_home, tmp_path, monkeypatch):
+    """Serving restores params-only from the stored spec: no Trainer, no
+    data pipeline (the training corpus need not exist on the serving host),
+    no optimizer moments in memory."""
+    from polyaxon_tpu.runtime.checkpoint import close_all
+
+    store, uuid = _train_run(tmp_path)
+    close_all()
+
+    def boom(*a, **k):
+        raise AssertionError("serving must not build the data pipeline")
+
+    monkeypatch.setattr("polyaxon_tpu.runtime.trainer.build_data", boom)
+    monkeypatch.setattr("polyaxon_tpu.data.build_data", boom)
+    server = ModelServer.from_run(uuid, store=store)
+    assert server.step == 4
+    out = server.generate({"tokens": [[1, 2, 3]], "maxNewTokens": 2})
+    assert len(out["tokens"][0]) == 5
