@@ -96,7 +96,8 @@ def _configs(on_tpu: bool) -> dict:
             unit="examples/sec", per_step=32, flops_per_item=None,
         ),
         "resnet50": dict(
-            model={"name": "resnet50", "config": {"num_classes": 10}},
+            model={"name": "resnet50",
+                   "config": {"num_classes": 10, "image_size": 64}},
             data={"name": "synthetic_imagenet", "batch_size": 4,
                   "config": {"image_size": 64, "num_classes": 10}},
             optimizer={"name": "sgd", "learning_rate": 0.1},
@@ -211,34 +212,84 @@ def bench_tuner(device, on_tpu: bool) -> dict:
     }
 
 
-_BEGIN = "<!-- baselines:begin -->"
-_END = "<!-- baselines:end -->"
+# separate marker pairs per section: a CPU run must never overwrite (or be
+# mistaken for) chip evidence, and vice versa — each run only rewrites the
+# section matching the device it ran on
+_SECTIONS = {
+    "tpu": (
+        "<!-- baselines:tpu:begin -->",
+        "<!-- baselines:tpu:end -->",
+        "### TPU-measured (perf evidence)",
+    ),
+    "cpu": (
+        "<!-- baselines:cpu:begin -->",
+        "<!-- baselines:cpu:end -->",
+        "### CPU smoke tier (proves the pipeline runs — NOT perf evidence)",
+    ),
+}
+
+
+def _is_tpu_row(row: dict) -> bool:
+    return "cpu" not in str(row.get("device_kind", "cpu")).lower()
+
+
+def _existing_rows(section_text: str) -> dict[str, str]:
+    """config name → rendered table line, parsed back out of a section so a
+    partial run (e.g. `run_baselines.py resnet50`) merges instead of
+    clobbering the other configs' rows."""
+    rows: dict[str, str] = {}
+    for line in section_text.splitlines():
+        line = line.strip()
+        if line.startswith("|") and not line.startswith(("|---", "| Config")):
+            name = line.split("|")[1].strip()
+            if name:
+                rows[name] = line
+    return rows
 
 
 def update_baseline_md(rows: list[dict]):
     md = REPO / "BASELINE.md"
     text = md.read_text()
     stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
-    table = [
-        "",
-        f"Measured by `benchmarks/run_baselines.py` on {stamp}:",
-        "",
-        "| Config | Value | Unit | MFU | Device | Final loss |",
-        "|---|---|---|---|---|---|",
-    ]
+    groups: dict[str, list[dict]] = {"tpu": [], "cpu": []}
     for r in rows:
-        table.append(
-            f"| {r['config']} | {r['value']:,} | {r['unit']} | "
-            f"{r['mfu'] if r['mfu'] is not None else '—'} | {r['device_kind']} | "
-            f"{r['final_loss'] if r['final_loss'] is not None else '—'} |"
-        )
-    block = _BEGIN + "\n" + "\n".join(table) + "\n" + _END
-    if _BEGIN in text:
-        pre = text.split(_BEGIN)[0]
-        post = text.split(_END)[1]
-        text = pre + block + post
-    else:
-        text = text.rstrip() + "\n\n## Measured numbers (this framework)\n\n" + block + "\n"
+        if r.get("error"):
+            # an errored config must never become (or overwrite) an
+            # evidence row — the canary runs this unattended on chip
+            print(f"skipping errored row: {r['config']}", file=sys.stderr)
+            continue
+        groups["tpu" if _is_tpu_row(r) else "cpu"].append(r)
+    for key in ("tpu", "cpu"):
+        if not groups[key]:
+            continue  # preserve the other section's existing rows
+        begin, end, title = _SECTIONS[key]
+        merged: dict[str, str] = {}
+        if begin in text:
+            merged = _existing_rows(text.split(begin)[1].split(end)[0])
+        for r in groups[key]:
+            merged[r["config"]] = (
+                f"| {r['config']} | {r['value']:,} | {r['unit']} | "
+                f"{r['mfu'] if r['mfu'] is not None else '—'} | "
+                f"{r['device_kind']} | "
+                f"{r['final_loss'] if r['final_loss'] is not None else '—'} |"
+            )
+        table = [
+            "",
+            title,
+            "",
+            f"Measured by `benchmarks/run_baselines.py`, last update {stamp}:",
+            "",
+            "| Config | Value | Unit | MFU | Device | Final loss |",
+            "|---|---|---|---|---|---|",
+            *merged.values(),
+        ]
+        block = begin + "\n" + "\n".join(table) + "\n" + end
+        if begin in text:
+            pre = text.split(begin)[0]
+            post = text.split(end)[1]
+            text = pre + block + post
+        else:
+            text = text.rstrip() + "\n\n" + block + "\n"
     md.write_text(text)
     print(f"updated {md}", file=sys.stderr)
 

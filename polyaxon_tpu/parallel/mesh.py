@@ -95,10 +95,19 @@ def _build_hybrid_mesh(sizes: dict[str, int], devices, slices: int) -> Mesh:
     per_slice = dict(sizes)
     per_slice["data"] = data // slices
     axes = tuple(per_slice.keys())
-    if all(hasattr(d, "slice_index") for d in devices):
-        # real multi-slice hardware: mesh_utils groups by slice_index. Do
-        # NOT fall back here — a wrong layout would silently put
-        # model/context collectives on DCN
+    on_tpu = any(getattr(d, "platform", "") == "tpu" for d in devices)
+    if on_tpu:
+        # real hardware: the devices' slice assignment must MATCH the spec
+        # — neither silently regrouping slices (model/context collectives
+        # would cross DCN) nor silently flattening them is acceptable.
+        # mesh_utils groups by slice_index.
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if None in slice_ids or len(slice_ids) != slices:
+            raise ValueError(
+                f"tpu devices span {len(slice_ids)} distinct slice(s) but "
+                f"the spec asks for slices={slices} — fix the job's slice "
+                "request or the mesh"
+            )
         from jax.experimental import mesh_utils
 
         dev_array = mesh_utils.create_hybrid_device_mesh(

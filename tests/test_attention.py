@@ -51,7 +51,9 @@ def test_flash_rejects_indivisible_seq():
         flash_attention(q, k, v, block_q=64, block_kv=64)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize(
+    "causal", [True, pytest.param(False, marks=pytest.mark.slow)]
+)
 def test_ring_matches_xla(causal):
     """Ring attention over a real context axis == single-device attention."""
     mesh = build_mesh({"data": 2, "context": 4})
@@ -82,6 +84,7 @@ def test_ring_backward_matches_xla():
         set_current_mesh(None)
 
 
+@pytest.mark.slow
 def test_ring_degrades_indivisible_batch():
     """B=1 (eval/decode) on a data×context mesh: the batch axis degrades to
     replication instead of a shard_map divisibility error."""

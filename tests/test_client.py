@@ -223,6 +223,7 @@ PROGRAM_OP = {
 }
 
 
+@pytest.mark.slow
 def test_restart_resume_copy(tmp_home, tmp_path):
     client = RunClient()
     src = client.create(_op(tmp_path, PROGRAM_OP), queue=False)

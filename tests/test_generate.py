@@ -34,9 +34,20 @@ def _naive_greedy(module, params, prompt, n):
     return toks
 
 
+def test_cached_decode_matches_full_reforward_fast():
+    """Fast-tier cache-correctness signal: prefill + 1 cached step against
+    the independent full-reforward reference (the naive reference
+    recompiles per length — 2 tokens keeps this cheap)."""
+    module, params, prompt = _setup()
+    out = generate(module, params, prompt, max_new_tokens=2, temperature=0.0)
+    ref = _naive_greedy(module, params, prompt, 2)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
 @pytest.mark.parametrize(
     "mode",
-    ["layers", pytest.param("scan", marks=pytest.mark.slow)],
+    [pytest.param("layers", marks=pytest.mark.slow),
+     pytest.param("scan", marks=pytest.mark.slow)],
 )
 def test_cached_decode_matches_full_reforward(mode):
     # 5 tokens exercise prefill + 4 cached steps; the naive reference

@@ -72,6 +72,7 @@ def test_moe_aux_loss_enters_total():
     assert leaves and all(np.isfinite(v) for v in leaves)
 
 
+@pytest.mark.slow
 def test_pipeline_forward_matches_sequential():
     cfg = {
         "preset": "tiny",

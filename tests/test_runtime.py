@@ -232,6 +232,7 @@ class TestCli:
         # mesh -1 resolved against the 2x4 tpu slice
         assert spec["component"]["run"]["mesh"] == {"data": 8}
 
+    @pytest.mark.slow
     def test_ops_compare(self, tmp_home):
         from click.testing import CliRunner
 

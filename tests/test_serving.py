@@ -73,6 +73,7 @@ def _train_run(tmp_path):
     return store, compiled.run_uuid
 
 
+@pytest.mark.slow
 def test_serve_checkpointed_run_end_to_end(tmp_home, tmp_path):
     from polyaxon_tpu.runtime.checkpoint import close_all
 

@@ -117,3 +117,32 @@ def test_ui_index_served(tmp_home):
         ).read().decode()
         assert "<!doctype html>" in html and "polyaxon-tpu" in html
         assert "/runs" in html  # polls the real JSON endpoints
+
+
+def test_dashboard_serves_and_covers_the_api(tmp_home):
+    """The dashboard page serves at / and wires every read endpoint it
+    renders (sparklines need /metrics, follow needs /logs?offset, stop
+    button needs POST /runs/<id>/stop) — a section silently dropping out
+    of the HTML means the feature regressed."""
+    import urllib.request
+
+    store = RunStore()
+    _seed_run(store)
+    with BackgroundServer(store) as srv:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/", timeout=10
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/html")
+            html = r.read().decode()
+    for needle in (
+        "sparkline",          # metric charts
+        "/metrics",
+        "logs?offset=",       # incremental follow
+        "/stop",              # stop action
+        "/artifacts",
+        "/spec",
+        "/events",
+        "conditions",
+        "esc(",               # escaping helper still in place
+    ):
+        assert needle in html, f"dashboard lost {needle!r}"
