@@ -312,28 +312,16 @@ def _spawn(env_extra: dict, timeout: float):
 
 
 def _probe_backend(timeout: float) -> bool:
-    """Cheap child that only touches jax.devices(): when the TPU tunnel is
-    healthy this returns in seconds; when it is down, backend init blocks
-    ~25 min — the probe's kill converts that into a fast CPU-fallback
-    decision instead of burning the whole bench budget."""
-    code = (
-        "import jax; d = jax.devices()[0]; "
-        "print('probe-ok', d.platform, d.device_kind)"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            env=dict(os.environ, POLYAXON_BENCH_CHILD=""),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    ok = proc.returncode == 0 and "probe-ok" in (proc.stdout or "")
+    """Killable-child backend probe: when the TPU tunnel is healthy this
+    returns in seconds; when it is down, backend init blocks ~25 min and
+    the probe's kill converts that into a fast CPU-fallback decision
+    instead of burning the whole bench budget. One shared implementation
+    with __graft_entry__ (utils/jax_platform.probe_backend_alive)."""
+    from polyaxon_tpu.utils.jax_platform import probe_backend_alive
+
+    ok = probe_backend_alive(timeout)
     if ok:
-        print(f"bench: {proc.stdout.strip()}", file=sys.stderr)
+        print("bench: backend probe ok", file=sys.stderr)
     return ok
 
 

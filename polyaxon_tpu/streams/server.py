@@ -88,7 +88,11 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     return self._send(200, body)
                 if sub == "metrics":
-                    return self._send(200, _json_bytes(store.read_metrics(uuid)))
+                    rows = store.read_metrics(uuid)
+                    tail = query.get("tail")
+                    if tail:  # bounded responses for pollers (dashboard)
+                        rows = rows[-max(1, int(tail)):]
+                    return self._send(200, _json_bytes(rows))
                 if sub == "events":
                     return self._send(200, _json_bytes(store.read_events(uuid)))
                 if sub == "spec":

@@ -138,7 +138,7 @@ async function detail() {
   const heavy = (tick++ % 10) === 0;  // spec/events/artifacts: selection +
                                       // every 10th poll, not every 3 s
   const [status, metrics, spec, events, arts] = await Promise.all([
-    j(`/runs/${uuid}/status`), j(`/runs/${uuid}/metrics`),
+    j(`/runs/${uuid}/status`), j(`/runs/${uuid}/metrics?tail=400`),
     heavy ? j(`/runs/${uuid}/spec`) : null,
     heavy ? j(`/runs/${uuid}/events`) : null,
     heavy ? j(`/runs/${uuid}/artifacts`) : null]);

@@ -54,6 +54,34 @@ def apply_platform(platform: str, n_cpu: int = 1) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def probe_backend_alive(timeout: float = 120.0) -> bool:
+    """Probe the native backend in a KILLABLE child: a dead TPU tunnel
+    blocks jax.devices() ~25 min inside native init, and no in-process
+    timeout can interrupt that — only killing a child can. Returns in
+    seconds when the backend is healthy, `timeout` worst-case when not.
+    Shared by bench.py and __graft_entry__ so the fallback policy can't
+    diverge."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "print('probe-ok', d.platform, d.device_kind)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "probe-ok" in (proc.stdout or "")
+
+
 def apply_platform_env() -> Optional[str]:
     """Apply POLYAXON_JAX_PLATFORM / POLYAXON_NUM_CPU_DEVICES if set.
     Returns the platform applied, or None when the env asks for nothing."""

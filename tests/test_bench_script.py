@@ -59,3 +59,44 @@ def test_decode_bench_emits_json(tmp_home):
     assert "beam4_decode_tokens_per_sec" in metrics
     for r in recs:
         assert r["value"] > 0, r
+
+
+def test_update_baseline_md_sections_merge_and_skip(tmp_path, monkeypatch):
+    """The BASELINE.md updater is consumed UNATTENDED by the TPU canary:
+    pin its contract — device sections are isolated, rows merge by config
+    across partial runs, errored rows never become evidence."""
+    import benchmarks.run_baselines as rb
+
+    md = tmp_path / "BASELINE.md"
+    md.write_text("# header\n")
+    monkeypatch.setattr(rb, "REPO", tmp_path)
+
+    def row(config, value, device, error=None):
+        r = {"config": config, "value": value, "unit": "tok/s", "mfu": None,
+             "device_kind": device, "final_loss": 1.0}
+        if error:
+            r["error"] = error
+        return r
+
+    # a TPU run writes the tpu section only
+    rb.update_baseline_md([row("bert", 100.0, "TPU v5 lite")])
+    text = md.read_text()
+    assert "TPU-measured" in text and "| bert | 100.0 |" in text
+    assert "CPU smoke" not in text
+
+    # a CPU run adds its own section without touching the TPU rows
+    rb.update_baseline_md([row("bert", 5.0, "cpu"), row("mnist", 9.0, "cpu")])
+    text = md.read_text()
+    assert "| bert | 100.0 |" in text  # TPU row preserved
+    assert "| bert | 5.0 |" in text and "| mnist | 9.0 |" in text
+
+    # partial re-run merges by config; errored rows are skipped
+    rb.update_baseline_md([
+        row("mnist", 11.0, "cpu"),
+        row("bert", 0.0, "cpu", error="OOM"),
+    ])
+    text = md.read_text()
+    assert "| mnist | 11.0 |" in text  # updated
+    assert "| bert | 5.0 |" in text  # untouched by the errored row
+    assert "| bert | 0.0 |" not in text
+    assert "| bert | 100.0 |" in text  # TPU section still intact

@@ -107,18 +107,6 @@ def test_tracking_client_roundtrip(tmp_home, monkeypatch):
     assert [m["loss"] for m in store.read_metrics(run.uuid)] == [1.0, 0.5]
 
 
-def test_ui_index_served(tmp_home):
-    import urllib.request
-
-    store = RunStore()
-    with BackgroundServer(store) as srv:
-        html = urllib.request.urlopen(
-            f"http://127.0.0.1:{srv.port}/"
-        ).read().decode()
-        assert "<!doctype html>" in html and "polyaxon-tpu" in html
-        assert "/runs" in html  # polls the real JSON endpoints
-
-
 def test_dashboard_serves_and_covers_the_api(tmp_home):
     """The dashboard page serves at / and wires every read endpoint it
     renders (sparklines need /metrics, follow needs /logs?offset, stop
