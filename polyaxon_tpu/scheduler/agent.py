@@ -119,6 +119,40 @@ class Agent:
         if current in DONE_STATUSES:
             return current
         op = V1Operation.model_validate(entry["payload"]["operation"])
+        if op.matrix is not None:
+            if self.submit_fn is not None:
+                # cluster agents render manifests — a sweep has no single
+                # manifest, and silently training trials in-process on the
+                # control-plane host would be wrong placement. Fail loudly;
+                # sweeps belong on an execution agent (in-process mode).
+                raise RuntimeError(
+                    "matrix (sweep) operations cannot be driven by a "
+                    "cluster-submitting agent; route them to an in-process "
+                    "execution agent's queue"
+                )
+            # a queued SWEEP: drive it under this run's uuid so the
+            # submitter's watch sees the sweep's lifecycle + iteration
+            # events. (Previously the matrix was silently dropped and one
+            # run with default params executed.)
+            from ..tuner.driver import run_sweep
+
+            summary = run_sweep(
+                op,
+                store=self.store,
+                project=entry["payload"].get("project"),
+                devices=self.executor.devices,
+                sweep_uuid=entry["uuid"],
+                catalog=self.executor.catalog,
+                log_fn=lambda line: self.store.append_log(
+                    entry["uuid"], str(line)
+                ),
+            )
+            self.store.append_log(
+                entry["uuid"],
+                f"sweep done: {len(summary['trials'])} trials, "
+                f"best {summary['best']}",
+            )
+            return self.store.get_status(entry["uuid"]).get("status")
         compiled = compile_operation(
             op,
             run_uuid=entry["uuid"],

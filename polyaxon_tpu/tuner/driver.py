@@ -65,6 +65,8 @@ class SweepDriver:
         project: Optional[str] = None,
         base_dir: Optional[str] = None,
         devices: Optional[list] = None,
+        sweep_uuid: Optional[str] = None,
+        catalog=None,
         log_fn=print,
     ):
         if op.matrix is None:
@@ -75,6 +77,10 @@ class SweepDriver:
         self.project = project
         self.base_dir = base_dir
         self.devices = devices
+        # reuse an existing run record as the sweep (the agent's queued-run
+        # path) instead of creating a fresh one
+        self.sweep_uuid = sweep_uuid
+        self.catalog = catalog
         self.log = log_fn
         metric = getattr(self.matrix, "metric", None)
         self.metric_name = metric.name if metric else "loss"
@@ -84,22 +90,33 @@ class SweepDriver:
     def run(self) -> SweepResult:
         import uuid as _uuid
 
-        sweep_uuid = _uuid.uuid4().hex
         mgr = build_manager(self.matrix)
-        self.store.create_run(
-            sweep_uuid,
-            (self.op.name or "sweep") + "-sweep",
-            self.project or "default",
-            {"matrix": self.matrix.to_dict()},
-            tags=["sweep"],
-        )
+        if self.sweep_uuid is not None:
+            # agent path: the queued run IS the sweep record — its status
+            # walk and metrics land where the submitter is watching
+            sweep_uuid = self.sweep_uuid
+        else:
+            sweep_uuid = _uuid.uuid4().hex
+            self.store.create_run(
+                sweep_uuid,
+                (self.op.name or "sweep") + "-sweep",
+                self.project or "default",
+                {"matrix": self.matrix.to_dict()},
+                tags=["sweep"],
+            )
+        from ..schemas.lifecycle import can_transition
+
         for s in (
             V1Statuses.COMPILED,
             V1Statuses.QUEUED,
             V1Statuses.SCHEDULED,
             V1Statuses.RUNNING,
         ):
-            self.store.set_status(sweep_uuid, s)
+            # transition-guarded: on the agent path the run arrives already
+            # QUEUED, so earlier rungs are no-ops rather than errors
+            current = self.store.get_status(sweep_uuid).get("status")
+            if current != s and can_transition(V1Statuses(current), s):
+                self.store.set_status(sweep_uuid, s)
         trials: list[TrialResult] = []
         iteration = 0
         try:
@@ -227,13 +244,18 @@ class SweepDriver:
             child_op,
             project=self.project,
             base_dir=self.base_dir,
+            # trials live in the same store tree as every other run —
+            # {{ globals.run_outputs_path }} must resolve under runs_dir
+            artifacts_root=str(self.store.runs_dir),
             iteration=iteration,
         )
         self.log(
             f"trial {compiled.run_uuid[:8]} params={params}"
             + (f" [bracket {sug.bracket} rung {sug.rung}]" if sug.bracket is not None else "")
         )
-        executor = Executor(store=self.store, devices=devices)
+        executor = Executor(
+            store=self.store, devices=devices, catalog=self.catalog
+        )
         status = executor.execute(compiled)
         objective = _objective_from_store(
             self.store, compiled.run_uuid, self.metric_name
@@ -253,15 +275,21 @@ def run_sweep(
     project: Optional[str] = None,
     base_dir: Optional[str] = None,
     devices: Optional[list] = None,
+    sweep_uuid: Optional[str] = None,
+    catalog=None,
     log_fn=print,
 ) -> dict:
-    """CLI-facing wrapper: run the sweep, return a JSON-able summary."""
+    """CLI/agent-facing wrapper: run the sweep, return a JSON-able summary.
+    `sweep_uuid` reuses an existing run record as the sweep (the agent's
+    queued-run path) instead of creating a fresh one."""
     driver = SweepDriver(
         op,
         store=store,
         project=project,
         base_dir=base_dir,
         devices=devices,
+        sweep_uuid=sweep_uuid,
+        catalog=catalog,
         log_fn=log_fn,
     )
     result = driver.run()

@@ -595,3 +595,97 @@ def test_asha_sweep_end_to_end(tmp_home, tmp_path):
     assert out["best"]["params"]["lr"] == 0.05
     # async promotion happened: some trial ran at more than minResource
     assert any(t["params"]["steps"] > 4 for t in out["trials"])
+
+
+def test_queued_sweep_executes_through_agent(tmp_home):
+    """A matrix operation submitted to the AGENT (queue / POST /runs path)
+    must run as a sweep under the queued run's uuid — regression: the
+    matrix used to be silently dropped and one default-params run
+    executed."""
+    import os
+    import tempfile
+    import textwrap
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import Agent
+    from polyaxon_tpu.store.local import RunStore
+
+    yaml_text = textwrap.dedent(
+        """
+        version: 1.1
+        kind: operation
+        name: queued-sweep
+        matrix:
+          kind: grid
+          params:
+            lr: {kind: choice, value: [0.05, 0.001]}
+        component:
+          kind: component
+          name: mlp-train
+          inputs:
+          - {name: lr, type: float, value: 0.001}
+          run:
+            kind: jaxjob
+            program:
+              model: {name: mlp, config: {input_dim: 32, num_classes: 4, hidden: [32]}}
+              data: {name: synthetic, batchSize: 16, config: {shape: [32], num_classes: 4}}
+              optimizer: {name: adamw, learningRate: "{{ params.lr }}"}
+              train: {steps: 4, logEvery: 4, precision: float32}
+        """
+    )
+    path = os.path.join(tempfile.mkdtemp(), "sweep.yaml")
+    with open(path, "w") as f:
+        f.write(yaml_text)
+    store = RunStore()
+    agent = Agent(store=store)
+    uuid = agent.submit(read_polyaxonfile(path))
+    agent.drain()
+
+    assert store.get_status(uuid)["status"] == "succeeded"
+    summaries = [
+        e for e in store.read_events(uuid) if e["kind"] == "sweep_summary"
+    ]
+    assert summaries and summaries[0]["trials"] == 2  # the grid, not 1 run
+    trial_runs = [r for r in store.list_runs() if r["uuid"] != uuid]
+    assert len(trial_runs) == 2
+    assert "sweep done" in store.read_logs(uuid)
+
+
+def test_cluster_agent_rejects_queued_sweep(tmp_home, tmp_path):
+    """A cluster-submitting agent must FAIL a queued sweep loudly, not
+    silently train trials in-process on the control-plane host."""
+    from tests.test_reconciler import FakeCluster
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import Agent
+    from polyaxon_tpu.scheduler.reconciler import ClusterSubmitter
+    from polyaxon_tpu.store.local import RunStore
+
+    spec = """
+version: 1.1
+kind: operation
+name: cluster-sweep
+matrix:
+  kind: grid
+  params:
+    lr: {kind: choice, value: [0.05, 0.001]}
+component:
+  kind: component
+  name: mlp-train
+  inputs:
+  - {name: lr, type: float, value: 0.001}
+  run:
+    kind: jaxjob
+    container: {image: img, command: [train]}
+"""
+    p = tmp_path / "sweep.yaml"
+    p.write_text(spec)
+    store = RunStore()
+    agent = Agent(
+        store=store, submit_fn=ClusterSubmitter(store, FakeCluster())
+    )
+    uuid = agent.submit(read_polyaxonfile(str(p)))
+    agent.drain()
+    status = store.get_status(uuid)
+    assert status["status"] == "failed"
+    assert "execution agent" in store.read_logs(uuid)
