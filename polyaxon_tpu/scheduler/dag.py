@@ -91,6 +91,8 @@ def _node_operation(node, dag_environment) -> V1Operation:
         data["hubRef"] = node.hub_ref
     if node.params:
         data["params"] = node.params
+    if getattr(node, "matrix", None):
+        data["matrix"] = node.matrix
     if dag_environment is not None:
         data["environment"] = dag_environment.to_dict()
     try:
@@ -162,9 +164,61 @@ def execute_dag(compiled, executor) -> None:
             )
             return
         op = _node_operation(node, dag.environment)
-        op = op.model_copy(
-            update={"params": _resolve_ops_context(op.params, outputs)}
-        )
+        try:
+            op = op.model_copy(
+                update={"params": _resolve_ops_context(op.params, outputs)}
+            )
+        except DagError as e:
+            # missing upstream output: fail THIS node through the normal
+            # bookkeeping (raising here would abort sibling collection)
+            statuses[name] = V1Statuses.FAILED
+            store.append_log(compiled.run_uuid, f"dag node {name}: {e}")
+            return
+        if op.matrix is not None:
+            # a SWEEP node: drive it through the tuner (a plain compile
+            # would silently drop the matrix). Downstream nodes read the
+            # winner via {{ ops.<name>.outputs.best.<param> }} — the
+            # sweep-then-train-best pipeline.
+            from ..tuner.driver import run_sweep
+
+            try:
+                summary = run_sweep(
+                    op,
+                    store=store,
+                    project=compiled.project,
+                    devices=executor.devices,
+                    catalog=executor.catalog,
+                    log_fn=lambda line: store.append_log(
+                        compiled.run_uuid, f"dag node {name}: {line}"
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — node fails, DAG decides
+                statuses[name] = V1Statuses.FAILED
+                store.append_log(
+                    compiled.run_uuid, f"dag node {name}: sweep failed: {e}"
+                )
+                return
+            best = summary.get("best")
+            if not best:
+                # no trial produced the objective: the sweep run is FAILED
+                # (driver semantics) and downstream best.* must not resolve
+                statuses[name] = V1Statuses.FAILED
+                store.append_log(
+                    compiled.run_uuid,
+                    f"dag node {name}: sweep produced no winner",
+                )
+                return
+            statuses[name] = V1Statuses.SUCCEEDED
+            node_out = {"best_objective": best.get("objective")}
+            for k, v in (best.get("params") or {}).items():
+                node_out[f"best.{k}"] = v
+            outputs[name] = node_out
+            store.append_log(
+                compiled.run_uuid,
+                f"dag node {name}: sweep {summary['sweep'][:8]} done, "
+                f"best {best.get('params')}",
+            )
+            return
         try:
             child = compile_operation(op, project=compiled.project)
         except CompilationError as e:

@@ -313,6 +313,9 @@ class V1OperationRef(BaseSchema):
     hub_ref: Optional[str] = None
     component: Optional[dict] = None  # inline component (validated lazily)
     params: Optional[dict[str, Any]] = None
+    # a sweep NODE: the dag walker drives it through the tuner and exposes
+    # the winner as {{ ops.<name>.outputs.best.<param> }}
+    matrix: Optional[dict[str, Any]] = None
     depends_on: Optional[list[str]] = None
     trigger: Optional[str] = None  # all_succeeded | all_done | one_succeeded ...
     conditions: Optional[str] = None

@@ -119,8 +119,17 @@ class SweepDriver:
                 self.store.set_status(sweep_uuid, s)
         trials: list[TrialResult] = []
         iteration = 0
+        stopped = False
         try:
             while not mgr.done:
+                # cooperative stop: a client may stop the (queued) sweep run
+                # mid-flight; halt between iterations — in-flight trials of
+                # the current batch run to completion
+                current = self.store.get_status(sweep_uuid).get("status")
+                if current in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+                    self.log("sweep stop requested; halting")
+                    stopped = True
+                    break
                 batch = mgr.suggest()
                 if not batch:
                     break
@@ -151,7 +160,7 @@ class SweepDriver:
                     self.log("early stopping: metric threshold crossed")
                     break
         except BaseException as e:
-            self.store.set_status(sweep_uuid, V1Statuses.FAILED, message=str(e))
+            self._settle(sweep_uuid, V1Statuses.FAILED, message=str(e))
             raise
         best = self._best(trials)
         self.store.log_event(
@@ -163,8 +172,34 @@ class SweepDriver:
                 "best_objective": best.objective if best else None,
             },
         )
-        self.store.set_status(sweep_uuid, V1Statuses.SUCCEEDED)
+        if stopped:
+            self._settle(sweep_uuid, V1Statuses.STOPPED, reason="stop requested")
+        elif best is None:
+            # every trial failed or none logged the objective metric — a
+            # sweep that produced nothing must not read as success (and the
+            # DAG path must not hand downstream nodes an empty winner)
+            self._settle(
+                sweep_uuid,
+                V1Statuses.FAILED,
+                message=(
+                    f"no trial produced objective metric "
+                    f"{self.metric_name!r} ({len(trials)} trials)"
+                ),
+            )
+        else:
+            self._settle(sweep_uuid, V1Statuses.SUCCEEDED)
         return SweepResult(sweep_uuid=sweep_uuid, trials=trials, best=best)
+
+    def _settle(self, sweep_uuid: str, target: V1Statuses, **kw) -> None:
+        """Transition-guarded terminal status (a concurrent stop may have
+        already settled the run — never raise over bookkeeping)."""
+        from ..schemas.lifecycle import can_transition
+
+        current = self.store.get_status(sweep_uuid).get("status")
+        if current == target:
+            return
+        if can_transition(V1Statuses(current), target):
+            self.store.set_status(sweep_uuid, target, **kw)
 
     def _score(self, trial: TrialResult) -> Optional[float]:
         """Manager-facing score: higher is better."""

@@ -339,3 +339,62 @@ def test_concurrency_zero_pauses_queue(tmp_home, tmp_path):
     QueueRegistry(store).set_queue("paused-q", concurrency=1)
     assert agent.drain() == 1
     assert store.get_status(uid)["status"] == V1Statuses.SUCCEEDED
+
+
+def test_dag_sweep_node_feeds_best_params_downstream(tmp_home, tmp_path):
+    """The sweep-then-train-best pipeline: a DAG node with a matrix runs
+    through the tuner and downstream nodes consume the winner via
+    {{ ops.<name>.outputs.best.<param> }}."""
+    import yaml
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "sweep-then-train",
+        "component": {
+            "kind": "component",
+            "name": "sweep-then-train",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {
+                        "name": "search",
+                        "component": MLP_COMPONENT,
+                        "matrix": {
+                            "kind": "grid",
+                            "params": {
+                                "lr": {"kind": "choice", "value": [0.05, 1.0e-09]}
+                            },
+                        },
+                    },
+                    {
+                        "name": "final",
+                        "dependsOn": ["search"],
+                        "component": MLP_COMPONENT,
+                        "params": {
+                            "lr": {"value": "{{ ops.search.outputs.best.lr }}"}
+                        },
+                    },
+                ],
+            },
+        },
+    }
+    path = _dag_yaml(tmp_path, yaml.safe_dump(spec))
+    op = read_polyaxonfile(path)
+    from polyaxon_tpu.compiler.resolver import compile_operation
+
+    store = RunStore()
+    compiled = compile_operation(op)
+    status = Executor(store).execute(compiled)
+    assert status == V1Statuses.SUCCEEDED
+    log = store.read_logs(compiled.run_uuid)
+    assert "sweep" in log and "best" in log
+    # the winning lr (0.05 trains to much lower loss than 1e-9) reached the
+    # final node's resolved spec
+    final_uuid = None
+    for r in store.list_runs():
+        spec_ = store.read_spec(r["uuid"])
+        if spec_.get("name") == "final":
+            final_uuid = r["uuid"]
+    assert final_uuid is not None
+    assert store.read_spec(final_uuid)["params"]["lr"] == 0.05

@@ -689,3 +689,101 @@ component:
     status = store.get_status(uuid)
     assert status["status"] == "failed"
     assert "execution agent" in store.read_logs(uuid)
+
+
+def test_sweep_with_no_objective_fails_not_succeeds(tmp_home, tmp_path):
+    """A sweep whose trials never log the objective metric must settle
+    FAILED — 'succeeded, best=None' hides a broken metric name."""
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.store.local import RunStore
+    from polyaxon_tpu.tuner import SweepDriver
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "bad-metric-sweep",
+        "matrix": {
+            "kind": "hyperopt",
+            "numRuns": 2,
+            "metric": {"name": "no_such_metric", "optimization": "minimize"},
+            "params": {"lr": {"kind": "uniform", "value": {"low": 0.001, "high": 0.01}}},
+        },
+        "component": {
+            "kind": "component",
+            "name": "mlp-train",
+            "inputs": [{"name": "lr", "type": "float", "value": 0.001}],
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {"name": "mlp", "config": {"input_dim": 16, "num_classes": 2, "hidden": [8]}},
+                    "data": {"name": "synthetic", "batchSize": 8, "config": {"shape": [16], "num_classes": 2}},
+                    "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                    "train": {"steps": 2, "logEvery": 2, "precision": "float32"},
+                },
+            },
+        },
+    }
+    p = tmp_path / "sweep.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    store = RunStore()
+    result = SweepDriver(
+        read_polyaxonfile(str(p)), store=store, log_fn=lambda *a: None
+    ).run()
+    assert result.best is None
+    assert store.get_status(result.sweep_uuid)["status"] == "failed"
+    msg = store.get_status(result.sweep_uuid)["conditions"][-1]["message"]
+    assert "no_such_metric" in msg
+
+
+def test_stopped_sweep_settles_stopped(tmp_home, tmp_path):
+    """A stop request on the sweep run halts the loop and settles STOPPED
+    (not an illegal-transition crash, not SUCCEEDED)."""
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.schemas.lifecycle import V1Statuses
+    from polyaxon_tpu.store.local import RunStore
+    from polyaxon_tpu.tuner import SweepDriver
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "stopped-sweep",
+        "matrix": {
+            "kind": "grid",
+            "params": {"lr": {"kind": "choice", "value": [0.01, 0.02]}},
+        },
+        "component": {
+            "kind": "component",
+            "name": "mlp-train",
+            "inputs": [{"name": "lr", "type": "float", "value": 0.001}],
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {"name": "mlp", "config": {"input_dim": 16, "num_classes": 2, "hidden": [8]}},
+                    "data": {"name": "synthetic", "batchSize": 8, "config": {"shape": [16], "num_classes": 2}},
+                    "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                    "train": {"steps": 2, "logEvery": 2, "precision": "float32"},
+                },
+            },
+        },
+    }
+    p = tmp_path / "sweep.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    store = RunStore()
+    # seed the sweep record pre-stopped (a client's stop raced the agent)
+    import uuid as _uuid
+
+    sweep_uuid = _uuid.uuid4().hex
+    store.create_run(sweep_uuid, "stopped-sweep", "default", {})
+    for s in (V1Statuses.COMPILED, V1Statuses.QUEUED, V1Statuses.SCHEDULED,
+              V1Statuses.RUNNING, V1Statuses.STOPPING):
+        store.set_status(sweep_uuid, s)
+    result = SweepDriver(
+        read_polyaxonfile(str(p)), store=store, sweep_uuid=sweep_uuid,
+        log_fn=lambda *a: None,
+    ).run()
+    assert result.trials == []  # halted before launching anything
+    assert store.get_status(sweep_uuid)["status"] == "stopped"
