@@ -198,8 +198,17 @@ def execute_dag(compiled, executor) -> None:
                     compiled.run_uuid, f"dag node {name}: sweep failed: {e}"
                 )
                 return
+            sweep_status = summary.get("status")
             best = summary.get("best")
-            if not best:
+            if sweep_status == V1Statuses.STOPPED:
+                # a user stop is neither success nor failure: downstream
+                # all_succeeded triggers won't fire, all_done ones can
+                statuses[name] = V1Statuses.STOPPED
+                store.append_log(
+                    compiled.run_uuid, f"dag node {name}: sweep stopped"
+                )
+                return
+            if not best or sweep_status == V1Statuses.FAILED:
                 # no trial produced the objective: the sweep run is FAILED
                 # (driver semantics) and downstream best.* must not resolve
                 statuses[name] = V1Statuses.FAILED

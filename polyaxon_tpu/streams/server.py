@@ -68,6 +68,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, INDEX_HTML.encode(), "text/html")
             if parts == ["healthz"]:
                 return self._send(200, _json_bytes({"status": "ok"}))
+            if parts == ["openapi.json"]:
+                from .openapi import spec as openapi_spec
+
+                return self._send(200, _json_bytes(openapi_spec()))
             if parts == ["runs"]:
                 return self._send(
                     200, _json_bytes(store.list_runs(query.get("project")))

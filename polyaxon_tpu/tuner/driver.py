@@ -104,6 +104,7 @@ class SweepDriver:
                 {"matrix": self.matrix.to_dict()},
                 tags=["sweep"],
             )
+            self.sweep_uuid = sweep_uuid  # expose to callers/stop hooks
         from ..schemas.lifecycle import can_transition
 
         for s in (
@@ -172,7 +173,11 @@ class SweepDriver:
                 "best_objective": best.objective if best else None,
             },
         )
-        if stopped:
+        # a stop may also have landed DURING the final batch (loop exits
+        # via mgr.done without re-reaching the check): STOPPING can only
+        # legally settle to STOPPED, never SUCCEEDED
+        current = self.store.get_status(sweep_uuid).get("status")
+        if stopped or current in (V1Statuses.STOPPING, V1Statuses.STOPPED):
             self._settle(sweep_uuid, V1Statuses.STOPPED, reason="stop requested")
         elif best is None:
             # every trial failed or none logged the objective metric — a
@@ -328,8 +333,13 @@ def run_sweep(
         log_fn=log_fn,
     )
     result = driver.run()
+    store = driver.store
     return {
         "sweep": result.sweep_uuid,
+        # terminal status of the sweep run: succeeded | failed | stopped —
+        # callers (DAG sweep nodes) must distinguish a user stop from a
+        # failure or a full search
+        "status": store.get_status(result.sweep_uuid).get("status"),
         "trials": [
             {
                 "uuid": t.run_uuid,

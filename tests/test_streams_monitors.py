@@ -134,3 +134,23 @@ def test_dashboard_serves_and_covers_the_api(tmp_home):
         "esc(",               # escaping helper still in place
     ):
         assert needle in html, f"dashboard lost {needle!r}"
+
+
+def test_openapi_spec_served_and_matches_router(tmp_home):
+    """/openapi.json serves a valid spec whose documented paths all exist
+    in the router (drives every documented GET against a seeded run)."""
+    store = RunStore()
+    uuid = _seed_run(store)
+    with BackgroundServer(store) as srv:
+        code, spec = _get(srv.port, "/openapi.json")
+        assert code == 200 and spec["openapi"].startswith("3.")
+        for path, ops in spec["paths"].items():
+            if "get" not in ops or "{path}" in path:
+                continue
+            concrete = path.replace("{uuid}", uuid)
+            code, _body = _get(srv.port, concrete)
+            assert code == 200, f"{concrete} -> {code}"
+        # the write-side routes are documented
+        assert "post" in spec["paths"]["/runs"]
+        assert "post" in spec["paths"]["/runs/{uuid}/stop"]
+        assert "delete" in spec["paths"]["/runs/{uuid}"]

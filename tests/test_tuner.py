@@ -787,3 +787,55 @@ def test_stopped_sweep_settles_stopped(tmp_home, tmp_path):
     ).run()
     assert result.trials == []  # halted before launching anything
     assert store.get_status(sweep_uuid)["status"] == "stopped"
+
+
+def test_stop_during_final_batch_settles_stopped(tmp_home, tmp_path):
+    """A stop that lands DURING the last batch (loop exits via mgr.done
+    without re-reaching the stop check) must still settle STOPPED — the
+    illegal stopping->succeeded transition used to strand the run
+    non-terminal forever."""
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.store.local import RunStore
+    from polyaxon_tpu.tuner import SweepDriver
+
+    spec = {
+        "version": 1.1,
+        "kind": "operation",
+        "name": "late-stop-sweep",
+        "matrix": {
+            "kind": "grid",
+            "params": {"lr": {"kind": "choice", "value": [0.01, 0.02]}},
+        },
+        "component": {
+            "kind": "component",
+            "name": "mlp-train",
+            "inputs": [{"name": "lr", "type": "float", "value": 0.001}],
+            "run": {
+                "kind": "jaxjob",
+                "program": {
+                    "model": {"name": "mlp", "config": {"input_dim": 16, "num_classes": 2, "hidden": [8]}},
+                    "data": {"name": "synthetic", "batchSize": 8, "config": {"shape": [16], "num_classes": 2}},
+                    "optimizer": {"name": "adamw", "learningRate": "{{ params.lr }}"},
+                    "train": {"steps": 2, "logEvery": 2, "precision": "float32"},
+                },
+            },
+        },
+    }
+    p = tmp_path / "sweep.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    store = RunStore()
+    driver = SweepDriver(read_polyaxonfile(str(p)), store=store,
+                         log_fn=lambda *a: None)
+    stopped_once = []
+
+    def stopping_log(*args):
+        # fire the stop at the first trial launch — mid final batch
+        if not stopped_once:
+            stopped_once.append(True)
+            store.request_stop(driver.sweep_uuid)
+
+    driver.log = stopping_log
+    result = driver.run()
+    assert store.get_status(result.sweep_uuid)["status"] == "stopped"
