@@ -130,6 +130,9 @@ class Trainer:
         self.log_fn = log_fn or (lambda step, m: None)
         self.checkpoint_dir = checkpoint_dir
 
+        from ..utils.jax_platform import apply_compilation_cache
+
+        apply_compilation_cache()  # 20-40s chip compiles amortize across runs
         self.bundle = build_model(program.model.name, program.model.config)
         dspec = program.data
         data_name = dspec.name if dspec else "synthetic"

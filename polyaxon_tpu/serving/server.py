@@ -197,6 +197,9 @@ class ModelServer:
                 f"run {uuid[:8]} has no checkpoints under its outputs — "
                 "train with train.checkpointEvery set"
             )
+        from ..utils.jax_platform import apply_compilation_cache
+
+        apply_compilation_cache()  # serve restarts reuse training compiles
         bundle = build_model(program.model.name, program.model.config)
         tspec = program.train
         seed = int(tspec.seed) if tspec else 0

@@ -293,6 +293,25 @@ class SweepDriver:
             f"trial {compiled.run_uuid[:8]} params={params}"
             + (f" [bracket {sug.bracket} rung {sug.rung}]" if sug.bracket is not None else "")
         )
+        # create the record up front so the trial carries its sweep lineage.
+        # The executor's later create_run is a no-op for existing runs, so
+        # everything it would have written must be merged here: the spec
+        # fingerprint (run-cache lookups key on it) and the operation's own
+        # tags (index filtering)
+        from ..compiler.resolver import spec_fingerprint
+
+        self.store.create_run(
+            compiled.run_uuid,
+            compiled.name,
+            compiled.project,
+            compiled.to_dict(),
+            tags=["trial", *(compiled.operation.tags or [])],
+            meta={
+                "sweep": sweep_uuid,
+                "iteration": iteration,
+                "fingerprint": spec_fingerprint(compiled),
+            },
+        )
         executor = Executor(
             store=self.store, devices=devices, catalog=self.catalog
         )

@@ -54,6 +54,44 @@ def apply_platform(platform: str, n_cpu: int = 1) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def apply_compilation_cache() -> Optional[str]:
+    """Enable JAX's persistent compilation cache under POLYAXON_HOME.
+
+    First XLA compile of a chip-sized model is 20-40 s; every Trainer in a
+    long-lived agent, every canary bench retry, and every serve restart
+    pays it again without this. The cache keys on (HLO, compile options,
+    jax/XLA version), so reuse is safe across processes. Opt out with
+    POLYAXON_COMPILE_CACHE=off; point elsewhere with
+    POLYAXON_COMPILE_CACHE=/path."""
+    raw = os.environ.get("POLYAXON_COMPILE_CACHE", "")
+    if raw.lower() in ("off", "0", "false", "disabled"):
+        return None
+    import jax
+
+    if not raw and jax.default_backend() == "cpu":
+        # default-on only for accelerator backends: XLA:CPU AOT cache
+        # entries embed host CPU features and reloading them warns about
+        # possible SIGILL on feature mismatch — and CPU compiles are cheap
+        # anyway. An explicit POLYAXON_COMPILE_CACHE path is honored.
+        return None
+    if raw:
+        path = raw
+    else:
+        from ..store.local import polyaxon_home
+
+        path = str(polyaxon_home() / "compile_cache")
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that took noticeable compile time (default only
+        # caches compilations >1s; tiny-but-hot serving signatures benefit)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — a cache is never worth failing a run
+        return None
+    return path
+
+
 def probe_backend_alive(timeout: float = 120.0) -> bool:
     """Probe the native backend in a KILLABLE child: a dead TPU tunnel
     blocks jax.devices() ~25 min inside native init, and no in-process
