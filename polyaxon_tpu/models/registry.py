@@ -51,6 +51,11 @@ class ModelBundle:
     # True if the module sows auxiliary losses into the `losses` collection
     # (e.g. MoE load balancing); the trainer adds them to the total loss.
     aux_losses: bool = False
+    # Optional fused head+loss: (params, features, batch) -> scalar. When
+    # set, the trainer applies the module with return_features=True and
+    # computes the loss from pre-head features — the [B, S, V] logits
+    # never materialize (ops/losses.fused_linear_masked_lm).
+    fused_loss: Optional[Callable] = None
 
 
 def register(name: str):

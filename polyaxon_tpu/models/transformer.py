@@ -61,6 +61,12 @@ class TransformerConfig:
     # pipeline parallelism: stage count (mesh `pipeline` axis size must match)
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # fuse the lm head into the loss (ops/losses.fused_linear_masked_lm):
+    # the [B,S,V] logits never materialize — the big activation-memory win
+    # at llama vocab sizes on DP/FSDP meshes. Leave off under tensor
+    # parallelism (the per-device logit shard is already V/tp small).
+    fused_lm_loss: bool = False
+    fused_loss_chunk: int = 8192
 
     @property
     def head_dim(self) -> int:
@@ -355,7 +361,14 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
+    def __call__(
+        self,
+        tokens,
+        *,
+        train: bool = False,
+        decode: bool = False,
+        return_features: bool = False,
+    ):
         cfg = self.cfg
         if decode and cfg.pipeline_stages > 1:
             raise ValueError(
@@ -384,6 +397,14 @@ class Transformer(nn.Module):
             for i in range(cfg.n_layers):
                 x = Block(cfg, train, decode, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if return_features:
+            # fused-loss path: the caller computes head+loss from features;
+            # the head params must still exist in the tree, so touch the
+            # module without using its output (init-time only — dead code
+            # after tracing)
+            if not cfg.tie_embeddings and self.is_initializing():
+                nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(x)
+            return x
         if cfg.tie_embeddings:
             return embed.attend(x.astype(jnp.float32))
         return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(x)
@@ -494,6 +515,23 @@ def build_transformer(config: dict) -> ModelBundle:
             else MOE_RULES
         )
         rules = moe_rules + rules
+    fused = None
+    if cfg.fused_lm_loss:
+        from ..ops.losses import fused_linear_masked_lm
+
+        def fused(params, features, batch):  # noqa: F811
+            kernel = (
+                params["embed"]["embedding"].T
+                if cfg.tie_embeddings
+                else params["lm_head"]["kernel"]
+            )
+            return fused_linear_masked_lm(
+                features,
+                kernel,
+                batch["labels"],
+                chunk_size=cfg.fused_loss_chunk,
+            )
+
     return ModelBundle(
         name="transformer_lm",
         module=module,
@@ -503,6 +541,7 @@ def build_transformer(config: dict) -> ModelBundle:
         task="lm",
         trainable_patterns=trainable,
         aux_losses=cfg.n_experts > 0,
+        fused_loss=fused,
     )
 
 

@@ -10,8 +10,10 @@ Batch schema: dict with "inputs" plus task-specific targets:
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -73,3 +75,127 @@ def accuracy(logits, batch) -> jnp.ndarray:
             mask.sum(), 1.0
         )
     return (pred == labels).astype(jnp.float32).mean()
+
+
+# ---------------------------------------------------------- fused lm head
+def fused_linear_masked_lm(features, kernel, labels, *, chunk_size=8192):
+    """Masked LM cross-entropy computed straight from pre-head FEATURES —
+    the lm-head matmul and the softmax are fused over vocab chunks so the
+    [B, S, V] logit tensor never materializes.
+
+    Why: at llama vocab sizes the logits dominate activation memory
+    (b8 x s1024 x v128k f32 = 4 GB forward + the same again for the
+    backward's dlogits) and their HBM round-trip is pure overhead — the
+    loss only needs one scalar per token. Chunking runs the head as
+    n_chunks MXU matmuls of [N, D] @ [D, C] with an online logsumexp
+    (same recurrence as flash attention's softmax), and the custom VJP
+    recomputes each chunk's logits instead of saving them. Peak extra
+    memory is one [N, C] block instead of [N, V].
+
+    Sharding note: intended for meshes where the vocab dim is NOT sharded
+    (single chip, DP/FSDP). Under tensor parallelism the regular path's
+    per-device logit shard is already V/tp small, and chunked slicing of
+    a V-sharded kernel would reshard every chunk.
+
+    features: [B, S, D] (any float dtype; math accumulates f32)
+    kernel:   [D, V] lm-head weight
+    labels:   [B, S] int32, -100 = ignore
+    → scalar f32 mean over unmasked positions (identical semantics to
+    `masked_lm`).
+    """
+    if chunk_size < 1:
+        raise ValueError(
+            f"fused_loss_chunk must be >= 1, got {chunk_size}"
+        )
+    B, S, D = features.shape
+    V = kernel.shape[1]
+    x = features.reshape(B * S, D)
+    flat = labels.reshape(B * S)
+    return _fused_lm(x, kernel, flat, int(chunk_size), V)
+
+
+def _chunks(V, chunk_size):
+    return [(lo, min(lo + chunk_size, V)) for lo in range(0, V, chunk_size)]
+
+
+def _chunk_logits(x, kernel, lo, hi):
+    return jax.lax.dot_general(
+        x,
+        jax.lax.slice_in_dim(kernel, lo, hi, axis=1),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _fused_lm_fwd_core(x, kernel, flat, chunk_size, V):
+    N = x.shape[0]
+    mask = (flat != -100).astype(jnp.float32)
+    safe = jnp.where(flat == -100, 0, flat)
+    m = jnp.full((N,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((N,), jnp.float32)
+    label_logit = jnp.zeros((N,), jnp.float32)
+    for lo, hi in _chunks(V, chunk_size):
+        logits = _chunk_logits(x, kernel, lo, hi)  # [N, C] f32
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]
+        ).sum(axis=1)
+        m = m_new
+        in_chunk = (safe >= lo) & (safe < hi)
+        idx = jnp.clip(safe - lo, 0, hi - lo - 1)
+        picked = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        label_logit = jnp.where(in_chunk, picked, label_logit)
+    lse = m + jnp.log(l)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = ((lse - label_logit) * mask).sum() / denom
+    return loss, (lse, mask, safe, denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_lm(x, kernel, flat, chunk_size, V):
+    return _fused_lm_fwd_core(x, kernel, flat, chunk_size, V)[0]
+
+
+def _fused_lm_fwd(x, kernel, flat, chunk_size, V):
+    loss, (lse, mask, safe, denom) = _fused_lm_fwd_core(
+        x, kernel, flat, chunk_size, V
+    )
+    return loss, (x, kernel, flat, lse, mask, safe, denom)
+
+
+def _fused_lm_bwd(chunk_size, V, res, dloss):
+    x, kernel, flat, lse, mask, safe, denom = res
+    # d(loss)/d(logits[n, v]) = (softmax - onehot) * mask_n / denom * dloss
+    scale = (mask / denom * dloss)[:, None]  # [N, 1] f32
+    dx = jnp.zeros(x.shape, jnp.float32)
+    dws = []
+    for lo, hi in _chunks(V, chunk_size):
+        logits = _chunk_logits(x, kernel, lo, hi)  # recompute, [N, C]
+        p = jnp.exp(logits - lse[:, None])
+        in_chunk = (safe >= lo) & (safe < hi)
+        idx = jnp.clip(safe - lo, 0, hi - lo - 1)
+        onehot = (
+            jax.nn.one_hot(idx, hi - lo, dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        g = (p - onehot) * scale  # [N, C] f32
+        w = jax.lax.slice_in_dim(kernel, lo, hi, axis=1)
+        dx = dx + jax.lax.dot_general(
+            g,
+            w,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dws.append(
+            jax.lax.dot_general(
+                x,
+                g,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    dkernel = jnp.concatenate(dws, axis=1).astype(kernel.dtype)
+    return dx.astype(x.dtype), dkernel, None
+
+
+_fused_lm.defvjp(_fused_lm_fwd, _fused_lm_bwd)

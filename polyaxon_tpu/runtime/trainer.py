@@ -293,16 +293,32 @@ class Trainer:
 
         collections = list(mutable) + (["losses"] if bundle.aux_losses else [])
 
+        fused_loss = bundle.fused_loss
+        if fused_loss is not None and (tspec.loss or bundle.loss) not in (
+            None,
+            "masked_lm",
+        ):
+            raise ValueError(
+                f"fused_lm_loss computes chunked masked-LM cross-entropy "
+                f"and cannot honor train.loss={tspec.loss or bundle.loss!r} "
+                "— drop the loss override or disable fused_lm_loss"
+            )
+        # static per-model: with a fused head+loss the module returns pre-
+        # head FEATURES and the loss computes the head in vocab chunks
+        # (the [B,S,V] logits never materialize — ops/losses.py)
+        apply_kw = {"return_features": True} if fused_loss is not None else {}
+
         def apply(params, extra, inputs, rng):
             rngs = {k: jax.random.fold_in(rng, i) for i, k in enumerate(bundle.rngs)}
             variables = {"params": params, **extra}
             if not collections:
                 logits = bundle.module.apply(
-                    variables, inputs, train=True, rngs=rngs
+                    variables, inputs, train=True, rngs=rngs, **apply_kw
                 )
                 return logits, {}, jnp.zeros((), jnp.float32)
             logits, updates = bundle.module.apply(
-                variables, inputs, train=True, rngs=rngs, mutable=collections
+                variables, inputs, train=True, rngs=rngs, mutable=collections,
+                **apply_kw
             )
             updates = dict(updates)
             sown = updates.pop("losses", {})
@@ -347,6 +363,11 @@ class Trainer:
                 if jnp.issubdtype(inputs.dtype, jnp.floating):
                     inputs = inputs.astype(compute_dtype)
                 logits, new_extra, aux = apply(compute_params, extra, inputs, rng)
+                if fused_loss is not None:  # `logits` carries features
+                    return (
+                        fused_loss(compute_params, logits, batch) + aux,
+                        (logits, new_extra),
+                    )
                 return loss_fn(logits, batch) + aux, (logits, new_extra)
 
             (loss, (logits, new_extra)), grads = jax.value_and_grad(
@@ -450,8 +471,13 @@ class Trainer:
             if jnp.issubdtype(inputs.dtype, jnp.floating):
                 inputs = inputs.astype(compute_dtype)
             variables = {"params": params, **state.extra}
-            logits = bundle.module.apply(variables, inputs, train=False)
-            loss = loss_fn(logits, batch).astype(jnp.float32)
+            logits = bundle.module.apply(
+                variables, inputs, train=False, **apply_kw
+            )
+            if fused_loss is not None:
+                loss = fused_loss(params, logits, batch).astype(jnp.float32)
+            else:
+                loss = loss_fn(logits, batch).astype(jnp.float32)
             metrics = {"eval.loss": loss}
             if is_classification:
                 metrics["eval.accuracy"] = accuracy_metric(logits, batch)
