@@ -123,8 +123,20 @@ class RunClient:
         disabled — a clone exists to actually execute, and an identical
         fingerprint would otherwise short-circuit to the source's results."""
         spec = self.store.read_spec(src_uuid)
-        if not spec or "component" not in spec:
+        if not spec or ("component" not in spec and "operation" not in spec):
             raise ClientError(f"run {src_uuid[:8]} has no stored spec")
+        raw = spec.get("operation")
+        if raw:
+            # preferred: the RAW pre-interpolation operation — templates,
+            # matrix, pathRef, queue, and tags all intact, so a cloned
+            # sweep actually varies its params again
+            data = dict(raw)
+            data["name"] = f"{spec.get('name') or raw.get('name') or 'run'}-{suffix}"
+            data["cache"] = {"disable": True}
+            return V1Operation.model_validate(data)
+        # legacy specs (pre raw-op storage): resolved component + params.
+        # Templates in the component were frozen at compile time, so clones
+        # of legacy sweep records re-train the recorded params only.
         params = {
             k: (v if isinstance(v, dict) and "value" in v else {"value": v})
             for k, v in (spec.get("params") or {}).items()
@@ -138,6 +150,7 @@ class RunClient:
                 # clones keep the source's queue routing and tags
                 "queue": spec.get("queue"),
                 "tags": spec.get("tags"),
+                "matrix": spec.get("matrix"),
             }
         )
 

@@ -97,11 +97,18 @@ class SweepDriver:
             sweep_uuid = self.sweep_uuid
         else:
             sweep_uuid = _uuid.uuid4().hex
+            # the RAW operation wholesale, so clones (ops restart) rebuild
+            # a submittable sweep — templates, matrix, pathRef, routing
+            # all intact
             self.store.create_run(
                 sweep_uuid,
                 (self.op.name or "sweep") + "-sweep",
                 self.project or "default",
-                {"matrix": self.matrix.to_dict()},
+                {
+                    "name": self.op.name,
+                    "operation": self.op.to_dict(),
+                    "matrix": self.matrix.to_dict(),
+                },
                 tags=["sweep"],
             )
             self.sweep_uuid = sweep_uuid  # expose to callers/stop hooks

@@ -351,3 +351,63 @@ def test_cli_run_against_remote_control_plane(tmp_home, tmp_path, monkeypatch):
         p2.write_text(yaml.safe_dump(sweep))
         res = CliRunner().invoke(cli, ["run", "-f", str(p2)])
         assert res.exit_code != 0 and "remote control plane" in res.output
+
+
+def test_restart_of_sweep_sweeps_again(tmp_home, tmp_path):
+    """ops restart of a sweep run must run a SWEEP again — the clone used
+    to drop the matrix and silently train one default-params run."""
+    import textwrap
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import Agent
+
+    yaml_text = textwrap.dedent(
+        """
+        version: 1.1
+        kind: operation
+        name: restartable-sweep
+        matrix:
+          kind: grid
+          params:
+            lr: {kind: choice, value: [0.05, 0.001]}
+        component:
+          kind: component
+          name: mlp-train
+          inputs:
+          - {name: lr, type: float, value: 0.001}
+          run:
+            kind: jaxjob
+            program:
+              model: {name: mlp, config: {input_dim: 16, num_classes: 2, hidden: [8]}}
+              data: {name: synthetic, batchSize: 8, config: {shape: [16], num_classes: 2}}
+              optimizer: {name: adamw, learningRate: "{{ params.lr }}"}
+              train: {steps: 2, logEvery: 2, precision: float32}
+        """
+    )
+    p = tmp_path / "sweep.yaml"
+    p.write_text(yaml_text)
+    store = RunStore()
+    agent = Agent(store=store)
+    uuid = agent.submit(read_polyaxonfile(str(p)))
+    agent.drain()
+    assert store.get_status(uuid)["status"] == "succeeded"
+
+    client = RunClient()
+    new_uuid = client.restart(uuid)
+    agent.drain()
+    assert store.get_status(new_uuid)["status"] == "succeeded"
+    summaries = [
+        e for e in store.read_events(new_uuid) if e["kind"] == "sweep_summary"
+    ]
+    assert summaries and summaries[0]["trials"] == 2  # swept, not 1 run
+    # the suggestions must actually REACH the trials' resolved specs —
+    # cloning the interpolated component would freeze every trial at the
+    # default lr while params claim otherwise
+    lrs = set()
+    for r in store.list_runs():
+        meta = store.get_status(r["uuid"]).get("meta", {})
+        if meta.get("sweep") == new_uuid:
+            spec = store.read_spec(r["uuid"])
+            opt = spec["component"]["run"]["program"]["optimizer"]
+            lrs.add(float(opt["learningRate"]))
+    assert lrs == {0.05, 0.001}, lrs
