@@ -347,12 +347,14 @@ def ops_stop(uid):
 @ops.command("delete")
 @click.option("-uid", "--uid", required=True)
 @click.option("--yes", is_flag=True, help="skip confirmation")
-def ops_delete(uid, yes):
+@click.option("--cascade", is_flag=True,
+              help="sweeps: also delete their trial runs")
+def ops_delete(uid, yes, cascade):
     """Delete a finished run's data (metrics, logs, outputs) permanently."""
     if not yes:
         click.confirm(f"permanently delete run {uid[:8]}?", abort=True)
     try:
-        _run_client().delete(uid)
+        _run_client().delete(uid, cascade=cascade)
     except ValueError as e:  # clone-target guard; group catches ClientError
         raise click.ClickException(str(e))
     click.echo(f"{uid[:8]} deleted")

@@ -108,12 +108,16 @@ class RunClient:
             return
         self.store.request_stop(self.store.resolve(uuid))
 
-    def delete(self, uuid: str):
-        """Permanently delete a finished run's data."""
+    def delete(self, uuid: str, *, cascade: bool = False):
+        """Permanently delete a finished run's data. Sweeps require
+        `cascade=True` to also remove their trial runs."""
         if self._http:
-            self._http.request("DELETE", f"/runs/{uuid}")
+            self._http.request(
+                "DELETE",
+                f"/runs/{uuid}" + ("?cascade=true" if cascade else ""),
+            )
             return
-        self.store.delete_run(self.store.resolve(uuid))
+        self.store.delete_run(self.store.resolve(uuid), cascade=cascade)
 
     # ------------------------------------------------- restart/resume/copy
     def _op_from_run(self, src_uuid: str, suffix: str) -> V1Operation:
@@ -128,9 +132,18 @@ class RunClient:
         raw = spec.get("operation")
         if raw:
             # preferred: the RAW pre-interpolation operation — templates,
-            # matrix, pathRef, queue, and tags all intact, so a cloned
-            # sweep actually varies its params again
+            # matrix, queue, and tags all intact, so a cloned sweep
+            # actually varies its params again
             data = dict(raw)
+            if not data.get("component") and spec.get("component"):
+                # path/hub refs were resolved at original compile time;
+                # re-resolving at clone time would depend on the current
+                # cwd and the file still existing — freeze the resolved
+                # component instead (its templates are interpolated, the
+                # legacy clone semantics for ref-based ops)
+                data["component"] = spec["component"]
+                data.pop("pathRef", None)
+                data.pop("hubRef", None)
             data["name"] = f"{spec.get('name') or raw.get('name') or 'run'}-{suffix}"
             data["cache"] = {"disable": True}
             return V1Operation.model_validate(data)
@@ -150,7 +163,6 @@ class RunClient:
                 # clones keep the source's queue routing and tags
                 "queue": spec.get("queue"),
                 "tags": spec.get("tags"),
-                "matrix": spec.get("matrix"),
             }
         )
 

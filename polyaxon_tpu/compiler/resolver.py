@@ -69,18 +69,11 @@ class CompiledOperation:
             # restart/resume/copy clones inherit them
             "queue": self.operation.queue,
             "tags": self.operation.tags,
-            # a sweep's matrix must survive too: a restarted sweep that
-            # silently trained ONE default-params run is the bug class the
-            # agent path already fixed
-            "matrix": (
-                self.operation.matrix.to_dict()
-                if self.operation.matrix is not None
-                else None
-            ),
-            # the RAW (pre-interpolation) operation: clones must rebuild
-            # from this, not from the resolved component above — templates
-            # like "{{ params.lr }}" are already frozen there, so a clone
-            # derived from it could never vary its params again
+            # the RAW (pre-interpolation) operation — matrix included:
+            # clones must rebuild from this, not from the resolved
+            # component above, where templates like "{{ params.lr }}" are
+            # already frozen and a cloned sweep could never vary its
+            # params again
             "operation": self.operation.to_dict(),
         }
 

@@ -131,19 +131,65 @@ class RunStore:
 
         return lock()
 
-    def delete_run(self, run_uuid: str) -> None:
+    def delete_run(self, run_uuid: str, *, cascade: bool = False) -> None:
         """Remove a run's directory, queue entries, and index entry. Refuses
         while the run is in an active state — stop it first. Data removal
-        failures propagate BEFORE the index is touched (no silent orphans)."""
-        import shutil
+        failures propagate BEFORE the index is touched (no silent orphans).
 
+        Sweep runs own trial runs (meta.sweep lineage): deleting the sweep
+        without `cascade` is refused rather than orphaning them, and with
+        `cascade` every trial must be deletable BEFORE anything is removed
+        (no half-deleted sweeps)."""
         from ..schemas.lifecycle import DONE_STATUSES
 
-        status = self.get_status(run_uuid).get("status")
-        if status and status not in DONE_STATUSES and status != V1Statuses.CREATED:
-            raise ValueError(
-                f"run {run_uuid[:8]} is {status}; stop it before deleting"
-            )
+        def _deletable(uuid: str):
+            status = self.get_status(uuid).get("status")
+            if (
+                status
+                and status not in DONE_STATUSES
+                and status != V1Statuses.CREATED
+            ):
+                raise ValueError(
+                    f"run {uuid[:8]} is {status}; stop it before deleting"
+                )
+
+        _deletable(run_uuid)
+        # only a SWEEP can own children — check the run's own spec before
+        # paying the store-wide scan (ordinary deletes stay O(1))
+        spec = self.read_spec(run_uuid)
+        is_sweep = bool(
+            spec.get("matrix")
+            or (spec.get("operation") or {}).get("matrix")
+        )
+        if is_sweep:
+            children = [
+                rec["uuid"]
+                for rec in self.list_runs()
+                if (self.get_status(rec["uuid"]).get("meta") or {}).get(
+                    "sweep"
+                )
+                == run_uuid
+            ]
+            if children:
+                if not cascade:
+                    raise ValueError(
+                        f"run {run_uuid[:8]} is a sweep with "
+                        f"{len(children)} trial runs; delete with cascade "
+                        "to remove them too"
+                    )
+                for child in children:
+                    _deletable(child)  # all-or-nothing: validate first
+                for child in children:
+                    # trials cannot themselves be sweeps: take the plain
+                    # removal path, no per-child store scan
+                    self._delete_one(child)
+        self._delete_one(run_uuid)
+
+    def _delete_one(self, run_uuid: str) -> None:
+        """The removal core: queue entries, run dir, index entry. Callers
+        have already validated deletability."""
+        import shutil
+
         # a stopped-while-queued run still has a queue entry; without this a
         # draining agent would resurrect the deleted run
         from ..scheduler.queue import QueueRegistry

@@ -215,10 +215,20 @@ def spec() -> dict:
             "/runs/{uuid}": {
                 "delete": {
                     "summary": "Delete a terminal run",
-                    "parameters": [run_param],
+                    "parameters": [
+                        run_param,
+                        {
+                            "name": "cascade",
+                            "in": "query",
+                            "schema": {"type": "boolean"},
+                            "description": "sweeps: also delete trial runs "
+                            "(refused otherwise)",
+                        },
+                    ],
                     "responses": {
                         "200": {"description": "deleted"},
-                        "409": {"description": "run still active"},
+                        "409": {"description": "run still active, or a "
+                                "sweep with trials and no cascade"},
                     },
                 }
             },

@@ -177,14 +177,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, _json_bytes({"error": str(e)}))
 
     def do_DELETE(self):  # noqa: N802 (stdlib naming)
-        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         store = self.store
         try:
             if len(parts) == 2 and parts[0] == "runs":
                 # no status.json check: stale index entries (dir lost
                 # out-of-band) must remain purgeable over the API
                 uuid = store.resolve(parts[1])
-                store.delete_run(uuid)
+                store.delete_run(
+                    uuid,
+                    cascade=query.get("cascade", "").lower()
+                    in ("1", "true", "yes"),
+                )
                 return self._send(200, _json_bytes({"deleted": uuid}))
             self._not_found(self.path)
         except KeyError as e:

@@ -411,3 +411,55 @@ def test_restart_of_sweep_sweeps_again(tmp_home, tmp_path):
             opt = spec["component"]["run"]["program"]["optimizer"]
             lrs.add(float(opt["learningRate"]))
     assert lrs == {0.05, 0.001}, lrs
+
+
+def test_sweep_delete_requires_cascade(tmp_home, tmp_path):
+    """Deleting a sweep run refuses without cascade (no orphan trials);
+    with cascade the sweep AND its trials go, all-or-nothing."""
+    import textwrap
+
+    from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import Agent
+
+    yaml_text = textwrap.dedent(
+        """
+        version: 1.1
+        kind: operation
+        name: del-sweep
+        matrix:
+          kind: grid
+          params:
+            lr: {kind: choice, value: [0.05, 0.001]}
+        component:
+          kind: component
+          name: mlp-train
+          inputs:
+          - {name: lr, type: float, value: 0.001}
+          run:
+            kind: jaxjob
+            program:
+              model: {name: mlp, config: {input_dim: 16, num_classes: 2, hidden: [8]}}
+              data: {name: synthetic, batchSize: 8, config: {shape: [16], num_classes: 2}}
+              optimizer: {name: adamw, learningRate: "{{ params.lr }}"}
+              train: {steps: 2, logEvery: 2, precision: float32}
+        """
+    )
+    p = tmp_path / "sweep.yaml"
+    p.write_text(yaml_text)
+    store = RunStore()
+    agent = Agent(store=store)
+    uuid = agent.submit(read_polyaxonfile(str(p)))
+    agent.drain()
+
+    client = RunClient()
+    with pytest.raises(ValueError, match="cascade"):
+        client.delete(uuid)
+    assert store.get_status(uuid)  # untouched
+
+    client.delete(uuid, cascade=True)
+    assert store.get_status(uuid) == {}
+    leftovers = [
+        r for r in store.list_runs()
+        if (store.get_status(r["uuid"]).get("meta") or {}).get("sweep") == uuid
+    ]
+    assert leftovers == []
