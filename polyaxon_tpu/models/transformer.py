@@ -214,10 +214,9 @@ class Attention(nn.Module):
 
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if nkv != nh:  # GQA: expand kv heads to query-head count
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA expansion is the attention dispatch's concern: the flash
+        # kernel consumes grouped kv natively (no repeated K/V in HBM),
+        # the einsum/ring/ulysses backends expand inside dot_product_attention
 
         from ..ops.attention import dot_product_attention
 

@@ -92,13 +92,30 @@ def _flash_sharded(q, k, v, *, causal: bool, block_kv: int, mesh):
     from ..parallel.mesh import BATCH_AXES
     from ..parallel.sharding import live_axes, shard_map_nocheck
 
+    import jax.numpy as jnp
+
     B, _, H, _ = q.shape
+    KV = k.shape[2]
     batch = live_axes(mesh, BATCH_AXES, B)
-    head = live_axes(mesh, ("model",), H)
-    spec = P(batch or None, None, head[0] if head else None, None)
+    # heads shard when BOTH head counts divide the model axis (KV | H, so
+    # the group structure survives the split). When only H divides (MQA /
+    # few kv heads vs a wide model axis), EXPAND kv first — losing the
+    # grouped-kv bandwidth saving but keeping head TP, which dominates.
+    model = mesh.shape.get("model", 1)
+    head_ax = live_axes(mesh, ("model",), KV)
+    head = head_ax[0] if head_ax and H % model == 0 else None
+    if head is None and model > 1 and H % model == 0 and KV < H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+        head = "model"
+    q_spec = P(batch or None, None, head, None)
+    kv_spec = P(batch or None, None, head, None)
     body = partial(flash_attention, causal=causal, block_kv=block_kv)
     fn = shard_map_nocheck(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
     )
     return fn(q, k, v)
 
@@ -106,9 +123,21 @@ def _flash_sharded(q, k, v, *, causal: bool, block_kv: int, mesh):
 def dot_product_attention(
     q, k, v, *, causal: bool, backend: str = "xla", block_kv: int = 512
 ):
-    """q/k/v: [B, S, H, D], equal head counts (expand GQA first) → [B, S, H, D]."""
+    """q: [B, S, H, D]; k/v: [B, S, KV, D] with KV dividing H → [B, S, H, D].
+
+    GQA expansion happens HERE, per backend: the flash kernel consumes
+    grouped kv natively (no repeated K/V in HBM); the einsum/ring/ulysses
+    paths get kv expanded to the query head count."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"query heads {q.shape[2]} not divisible by kv heads {k.shape[2]}"
+        )
     if backend == "auto":
         backend = resolve_auto_backend(q.shape[1], block_kv, q.shape[-1])
+    if backend != "flash" and k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if backend == "flash":
         from .flash_attention import flash_attention
         from ..parallel.ring import current_mesh

@@ -83,7 +83,7 @@ def _fwd_kernel(
         lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_kv):
+def _fwd(q, k, v, causal, scale, block_q, block_kv, group=1):
     from jax.experimental.pallas import tpu as pltpu
 
     BH, S, D = q.shape
@@ -96,8 +96,11 @@ def _fwd(q, k, v, causal, scale, block_q, block_kv):
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            # GQA: `group` query heads share one kv head — the kv operands
+            # stay [B*KV, S, D] and the grid's head index maps down, so
+            # repeated K/V never materialize in HBM
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j, g=group: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -168,12 +171,16 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal, block_q, block_kv,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_kv, nq_seq,
 ):
-    ik, iq = pl.program_id(1), pl.program_id(2)
-    nq = pl.num_programs(2)
+    # grid dim 2 walks the q blocks of EVERY query head sharing this kv
+    # head (GQA): step t = member * nq_seq + q-block; the scratch
+    # accumulates dk/dv across all of them sequentially
+    ik, it = pl.program_id(1), pl.program_id(2)
+    nt = pl.num_programs(2)
+    iq = it % nq_seq  # q-block index within the sequence
 
-    @pl.when(iq == 0)
+    @pl.when(it == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -215,25 +222,25 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(iq == nq - 1)
+    @pl.when(it == nt - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 # ------------------------------------------------------------------ custom vjp
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_kv):
-    o, _ = _fwd(q, k, v, causal, scale, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_kv, group):
+    o, _ = _fwd(q, k, v, causal, scale, block_q, block_kv, group)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
-    o, lse = _fwd(q, k, v, causal, scale, block_q, block_kv)
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, group):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_kv, group)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, res, do):
+def _flash_bwd(causal, scale, block_q, block_kv, group, res, do):
     from jax.experimental.pallas import tpu as pltpu
 
     q, k, v, o, lse = res
@@ -247,8 +254,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, do):
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j, g=group: (b // g, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -260,15 +267,19 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, do):
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **common),
-        grid=(BH, nk, nq),
+        functools.partial(_dkv_kernel, nq_seq=nq, **common),
+        grid=(BH // group, nk, nq * group),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n, 0)),
+            pl.BlockSpec((1, block_q),
+                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n)),
+            pl.BlockSpec((1, block_q),
+                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
@@ -294,17 +305,31 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(
     q, k, v, *, causal=True, block_q=128, block_kv=128, sm_scale=None
 ):
-    """q/k/v: [B, S, H, D] (same head count — expand GQA before calling).
+    """q: [B, S, H, D]; k/v: [B, S, KV, D] with KV dividing H.
+
+    GQA is native: when KV < H the kernel maps each group of H/KV query
+    heads onto one kv head through the grid index maps — the repeated K/V
+    copies (`jnp.repeat` before the call) never exist in HBM, which at
+    llama ratios (H/KV = 4) cuts the kernel's K/V read traffic 4x. The
+    backward accumulates dk/dv across the group inside the kv-block
+    scratch (one extra grid dim, still race-free sequential steps).
     Returns [B, S, H, D]."""
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KV}")
+    group = H // KV
     block_q = min(block_q, S)
     block_kv = min(block_kv, S)
     if S % block_q or S % block_kv:
         raise ValueError(f"seq len {S} not divisible by blocks {block_q}/{block_kv}")
     scale = sm_scale if sm_scale is not None else D ** -0.5
 
-    def to_bh(x):  # [B,S,H,D] -> [B*H, S, D]
-        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    def to_bh(x):  # [B,S,h,D] -> [B*h, S, D]
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, scale, block_q, block_kv)
+    o = _flash(
+        to_bh(q), to_bh(k), to_bh(v), causal, scale, block_q, block_kv, group
+    )
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -314,3 +314,88 @@ def test_flash_sharded_degrades_indivisible_dims():
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
     finally:
         set_current_mesh(None)
+
+
+# ----------------------------------------------------------- GQA native
+@pytest.mark.parametrize("causal", [True, pytest.param(False, marks=pytest.mark.slow)])
+def test_flash_gqa_native_matches_expanded(causal):
+    """Grouped-query flash: kv stays [B,S,KV,D] (no repeated K/V in HBM);
+    output and ALL grads match the expand-then-attend reference."""
+    B, S, H, KV, D = 2, 64, 8, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+
+    def ref(q, k, v):
+        ke = jnp.repeat(k, H // KV, axis=2)
+        ve = jnp.repeat(v, H // KV, axis=2)
+        return dot_product_attention(q, ke, ve, causal=causal, backend="xla")
+
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_kv=32)
+    np.testing.assert_allclose(out, ref(q, k, v), atol=2e-5, rtol=2e-5)
+    g1 = jax.grad(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=32, block_kv=32
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(lambda q, k, v: ref(q, k, v).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_flash_sharded_gqa_on_mesh():
+    """backend=flash with grouped kv on a live TP mesh: kv heads shard
+    over `model` when they divide, and the result matches the expanded
+    einsum reference."""
+    mesh = build_mesh({"data": 4, "model": 2})
+    set_current_mesh(mesh)
+    try:
+        B, S, H, KV, D = 4, 64, 8, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+        ref = dot_product_attention(
+            q,
+            jnp.repeat(k, H // KV, axis=2),
+            jnp.repeat(v, H // KV, axis=2),
+            causal=True,
+            backend="xla",
+        )
+        out = dot_product_attention(q, k, v, causal=True, backend="flash")
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_flash_sharded_mqa_expands_to_keep_tp():
+    """KV smaller than the model axis (MQA-ish): kv expands so head TP is
+    kept rather than replicating every query head per device."""
+    mesh = build_mesh({"data": 2, "model": 4})
+    set_current_mesh(mesh)
+    try:
+        B, S, H, KV, D = 2, 64, 8, 1, 16
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+        ref = dot_product_attention(
+            q,
+            jnp.repeat(k, H, axis=2),
+            jnp.repeat(v, H, axis=2),
+            causal=True,
+            backend="xla",
+        )
+        out = dot_product_attention(q, k, v, causal=True, backend="flash")
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_attention_rejects_indivisible_gqa_heads():
+    q, k, v = _qkv(H=4)
+    k5 = jnp.concatenate([k, k[:, :, :1] * 0 + 1.0], axis=2)[:, :, :3]
+    with pytest.raises(ValueError, match="divisible"):
+        dot_product_attention(q[:, :, :4], k5, k5, causal=True, backend="xla")
