@@ -183,15 +183,32 @@ def _run_client():
 
 @ops.command("ls")
 @click.option("--project", default=None)
-def ops_ls(project):
-    rows = _run_client().list(project)
+@click.option("--sweep", "sweep_ref", default=None,
+              help="only this sweep's trial runs (lineage from run meta)")
+def ops_ls(project, sweep_ref):
+    client = _run_client()
+    rows = client.list(project)
+    if sweep_ref:
+        # resolve via a status fetch: works identically for the local
+        # store and the HTTP transport (the server resolves short refs)
+        sweep_uuid = client.get(sweep_ref).get("uuid") or sweep_ref
+        kept = []
+        for r in rows:
+            meta = r.get("meta") or {}  # listings carry meta — no N+1
+            if meta.get("sweep") == sweep_uuid:
+                kept.append({**r, "iteration": meta.get("iteration")})
+        rows = kept
     if not rows:
         click.echo("no runs")
         return
     for r in rows:
-        click.echo(
-            f"{r['uuid'][:8]}  {r.get('status', '?'):<12} {r.get('project', ''):<12} {r.get('name', '')}"
+        line = (
+            f"{r['uuid'][:8]}  {r.get('status', '?'):<12} "
+            f"{r.get('project', ''):<12} {r.get('name', '')}"
         )
+        if sweep_ref:
+            line += f"  [iter {r.get('iteration')}]"
+        click.echo(line)
 
 
 @ops.command("get")

@@ -92,8 +92,6 @@ def _flash_sharded(q, k, v, *, causal: bool, block_kv: int, mesh):
     from ..parallel.mesh import BATCH_AXES
     from ..parallel.sharding import live_axes, shard_map_nocheck
 
-    import jax.numpy as jnp
-
     B, _, H, _ = q.shape
     KV = k.shape[2]
     batch = live_axes(mesh, BATCH_AXES, B)

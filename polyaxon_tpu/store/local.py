@@ -277,6 +277,12 @@ class RunStore:
                 continue
             status = self.get_status(rec["uuid"])
             rec["status"] = status.get("status", "unknown")
+            # status.json is already read: meta rides along for free —
+            # listings can filter on lineage (sweep trials) without an
+            # N+1 status fetch per run
+            meta = status.get("meta")
+            if meta:
+                rec["meta"] = meta
             out.append(rec)
         return out
 
