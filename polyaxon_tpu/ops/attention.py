@@ -132,7 +132,10 @@ def dot_product_attention(
         )
     if backend == "auto":
         backend = resolve_auto_backend(q.shape[1], block_kv, q.shape[-1])
-    if backend != "flash" and k.shape[2] != q.shape[2]:
+    # flash consumes grouped kv natively; ulysses scatters it at kv-head
+    # width (4x less all-to-all traffic at llama ratios) and expands
+    # internally only when the shards don't divide
+    if backend in ("xla", "ring") and k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

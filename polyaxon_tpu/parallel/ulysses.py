@@ -22,6 +22,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 try:
@@ -56,9 +57,13 @@ def ulysses_attention(
 ):
     """Attention with Q/K/V sequence-sharded over `axis_name`.
 
-    q/k/v: [B, S, H, D] global shapes (same head count — expand GQA first).
-    Falls back to single-device flash attention when the mesh has no
-    (non-trivial) context axis, mirroring ring_attention's contract."""
+    q: [B, S, H, D]; k/v: [B, S, KV, D] with KV dividing H — pass GQA kv
+    UNEXPANDED: when the kv shards divide the model axis and context
+    degree they ride the all-to-all at true kv-head width (4x less K/V
+    traffic at llama ratios) and the flash kernel consumes the groups
+    natively; indivisible shapes expand internally. Falls back to the
+    sharded flash dispatch when the mesh has no (non-trivial) context
+    axis, mirroring ring_attention's contract."""
     mesh = current_mesh()
     n = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
     if n <= 1:
@@ -78,21 +83,43 @@ def ulysses_attention(
         return dot_product_attention(q, k, v, causal=causal, backend="xla")
     from .sharding import live_axes, shard_map_nocheck
 
-    head_live = live_axes(mesh, ("model",), q.shape[2])
-    local_heads = q.shape[2] // mesh.shape["model"] if head_live else q.shape[2]
+    H, KV = q.shape[2], k.shape[2]
+    model = mesh.shape.get("model", 1)
+    head_live = live_axes(mesh, ("model",), H)
+    local_heads = H // model if head_live else H
     if local_heads % n != 0:
         raise ValueError(
             f"ulysses needs local head count {local_heads} divisible by the "
             f"context degree {n} (heads are scattered); use attention: ring "
             "for this shape"
         )
+    # GQA: kv ride the all-to-all at their TRUE head width when the kv
+    # shards divide both the model axis and the context degree (4x less
+    # K/V traffic at llama ratios; the flash kernel consumes grouped kv
+    # natively). Otherwise expand — correct, just more traffic.
+    local_kv = KV // model if head_live else KV
+    kv_grouped = (
+        KV == H
+        or ((KV % model == 0 if head_live else True) and local_kv % n == 0)
+    )
+    if not kv_grouped:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+        KV = H
     # batch degrades to replication when it doesn't divide (B=1 eval)
     batch = live_axes(mesh, BATCH_AXES, q.shape[0]) or None
-    spec = P(batch, axis_name, head_live[0] if head_live else None, None)
+    head = head_live[0] if head_live else None
+    # by here head is only non-None when KV % model == 0 (kv_grouped's
+    # conditions or the expand branch guarantee it) — one spec serves both
+    q_spec = P(batch, axis_name, head, None)
+    kv_spec = q_spec
     body = partial(
         _ulysses_body, axis_name=axis_name, causal=causal, block_kv=block_kv
     )
     inner = shard_map_nocheck(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
     )
     return inner(q, k, v)
