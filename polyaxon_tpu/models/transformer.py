@@ -214,9 +214,11 @@ class Attention(nn.Module):
 
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # GQA expansion is the attention dispatch's concern: the flash
-        # kernel consumes grouped kv natively (no repeated K/V in HBM),
-        # the einsum/ring/ulysses backends expand inside dot_product_attention
+        # GQA expansion is the attention dispatch's concern: flash consumes
+        # grouped kv natively (no repeated K/V in HBM), ring rotates it and
+        # ulysses scatters it at true kv-head width; only the plain einsum
+        # gets kv expanded inside dot_product_attention. Do NOT pre-expand
+        # here — that would forfeit those bandwidth savings.
 
         from ..ops.attention import dot_product_attention
 

@@ -132,10 +132,11 @@ def dot_product_attention(
         )
     if backend == "auto":
         backend = resolve_auto_backend(q.shape[1], block_kv, q.shape[-1])
-    # flash consumes grouped kv natively; ulysses scatters it at kv-head
-    # width (4x less all-to-all traffic at llama ratios) and expands
-    # internally only when the shards don't divide
-    if backend in ("xla", "ring") and k.shape[2] != q.shape[2]:
+    # flash consumes grouped kv natively; ring rotates it and ulysses
+    # scatters it at kv-head width (4x less fabric traffic at llama
+    # ratios), both expanding internally only when shards don't divide.
+    # Only the plain einsum needs pre-expanded kv.
+    if backend == "xla" and k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

@@ -59,15 +59,43 @@ def _chunk_attention(q, k, v, scale, full, same):
     full/same are scalar bools (chunk provenance); masked-out entries get
     probability 0 via the `allowed` mask, never a -inf softmax (avoids the
     all-masked NaN)."""
-    S_q, S_k = q.shape[1], k.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    B, S_q, H, D = q.shape
+    S_k, KV = k.shape[1], k.shape[2]
+    if H == KV:
+        s = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+    else:
+        # GQA: score against the TRUE kv heads — the rotating K/V chunks
+        # stay at kv width, never expanded. Head order h = kv*G + g
+        # matches jnp.repeat's, so downstream [b,h,q,k] logic is unchanged.
+        G = H // KV
+        s = (
+            jnp.einsum(
+                "bqkgd,bskd->bkgqs",
+                q.reshape(B, S_q, KV, G, D),
+                k,
+                preferred_element_type=jnp.float32,
+            ).reshape(B, H, S_q, S_k)
+            * scale
+        )
     tril = jnp.tril(jnp.ones((S_q, S_k), bool))
     allowed = full | (same & tril[None, None])
     s = jnp.where(allowed, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # [b,h,q,1]
     p = jnp.where(allowed, jnp.exp(s - m), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    if H == KV:
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    else:
+        o = jnp.einsum(
+            "bkgqs,bskd->bqkgd",
+            p.reshape(B, KV, H // KV, S_q, S_k).astype(v.dtype),
+            v,
+        ).reshape(B, S_q, H, D)
     return o, m, l
 
 
@@ -101,10 +129,13 @@ def ring_attention(
 ):
     """Attention with Q/K/V sequence-sharded over `axis_name`.
 
-    q/k/v: [B, S, H, D] global shapes (same head count — expand GQA first).
-    Falls back to single-device flash attention when the mesh has no
-    (non-trivial) context axis, so models can use `attention: ring`
-    unconditionally."""
+    q: [B, S, H, D]; k/v: [B, S, KV, D] with KV dividing H — pass GQA kv
+    UNEXPANDED: the rotating K/V chunks then travel the ring at true
+    kv-head width (4x less ICI traffic per hop at llama ratios) and the
+    blockwise math scores groups directly. kv expands internally only
+    when head TP needs it (KV doesn't divide the model axis). Falls back
+    to the sharded flash dispatch when the mesh has no (non-trivial)
+    context axis, so models can use `attention: ring` unconditionally."""
     mesh = current_mesh()
     n = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
     scale = q.shape[-1] ** -0.5
@@ -127,14 +158,21 @@ def ring_attention(
 
     # batch/head axes degrade to replication when they don't divide
     # (e.g. B=1 eval batches on a data×context mesh)
+    H, KV = q.shape[2], k.shape[2]
     batch = live_axes(mesh, BATCH_AXES, q.shape[0]) or None
-    head_live = live_axes(mesh, ("model",), q.shape[2])
+    head_live = live_axes(mesh, ("model",), H)
     head = head_live[0] if head_live else None
-    spec = P(batch, axis_name, head, None)
+    model = mesh.shape.get("model", 1)
+    if KV != H and head is not None and KV % model != 0:
+        # head TP needs the kv heads to split with the q heads: expand —
+        # correct, just without the grouped-kv ring-traffic saving
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    q_spec = P(batch, axis_name, head, None)
     inner = shard_map(
         partial(_ring_body, axis_name=axis_name, n=n, scale=scale, causal=causal),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(q_spec, q_spec, q_spec),
+        out_specs=q_spec,
     )
     return inner(q, k, v)

@@ -479,3 +479,107 @@ def test_ulysses_gqa_with_model_axis(kv):
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
     finally:
         set_current_mesh(None)
+
+
+@pytest.mark.parametrize("kv", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_ring_gqa_grouped_matches_expanded(kv):
+    """GQA ring: K/V rotate the ring at true kv-head width; result matches
+    the expanded reference."""
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        B, S, H, D = 2, 64, 8, 16
+        ks = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, kv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, kv, D), jnp.float32)
+        ref = dot_product_attention(
+            q,
+            jnp.repeat(k, H // kv, axis=2),
+            jnp.repeat(v, H // kv, axis=2),
+            causal=True,
+            backend="xla",
+        )
+        out = ring_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+@pytest.mark.slow
+def test_ring_gqa_backward_matches_expanded():
+    mesh = build_mesh({"data": 2, "context": 4})
+    set_current_mesh(mesh)
+    try:
+        B, S, H, KV, D = 2, 64, 8, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(10), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+        g1 = jax.grad(
+            lambda q, k, v: ring_attention(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: dot_product_attention(
+                q,
+                jnp.repeat(k, H // KV, axis=2),
+                jnp.repeat(v, H // KV, axis=2),
+                causal=True,
+                backend="xla",
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5, err_msg=name)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ring_gqa_grouped_with_model_axis():
+    """The riskiest path: grouped KV stays unexpanded while a live model
+    axis shards heads (KV % model == 0) — per-shard group alignment must
+    survive the head split AND the ring rotation."""
+    mesh = build_mesh({"data": 2, "context": 2, "model": 2})
+    set_current_mesh(mesh)
+    try:
+        B, S, H, KV, D = 2, 64, 8, 2, 16  # KV=2 % model=2 == 0: grouped
+        ks = jax.random.split(jax.random.PRNGKey(12), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+        ref = dot_product_attention(
+            q,
+            jnp.repeat(k, H // KV, axis=2),
+            jnp.repeat(v, H // KV, axis=2),
+            causal=True,
+            backend="xla",
+        )
+        out = ring_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
+
+
+def test_ring_gqa_with_model_axis_expands_when_needed():
+    """TP+context with KV % model != 0 forces the internal expansion —
+    correct result either way."""
+    mesh = build_mesh({"data": 2, "context": 2, "model": 2})
+    set_current_mesh(mesh)
+    try:
+        B, S, H, KV, D = 2, 64, 8, 1, 16  # KV=1 % model=2 != 0
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+        ref = dot_product_attention(
+            q,
+            jnp.repeat(k, H, axis=2),
+            jnp.repeat(v, H, axis=2),
+            causal=True,
+            backend="xla",
+        )
+        out = ring_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    finally:
+        set_current_mesh(None)
