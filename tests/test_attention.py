@@ -317,7 +317,11 @@ def test_flash_sharded_degrades_indivisible_dims():
 
 
 # ----------------------------------------------------------- GQA native
-@pytest.mark.parametrize("causal", [True, pytest.param(False, marks=pytest.mark.slow)])
+@pytest.mark.parametrize(
+    "causal",
+    [pytest.param(True, marks=pytest.mark.slow),
+     pytest.param(False, marks=pytest.mark.slow)],
+)
 def test_flash_gqa_native_matches_expanded(causal):
     """Grouped-query flash: kv stays [B,S,KV,D] (no repeated K/V in HBM);
     output and ALL grads match the expand-then-attend reference."""
@@ -481,7 +485,11 @@ def test_ulysses_gqa_with_model_axis(kv):
         set_current_mesh(None)
 
 
-@pytest.mark.parametrize("kv", [2, pytest.param(4, marks=pytest.mark.slow)])
+@pytest.mark.parametrize(
+    "kv",
+    [pytest.param(2, marks=pytest.mark.slow),
+     pytest.param(4, marks=pytest.mark.slow)],
+)
 def test_ring_gqa_grouped_matches_expanded(kv):
     """GQA ring: K/V rotate the ring at true kv-head width; result matches
     the expanded reference."""

@@ -353,6 +353,7 @@ def test_cli_run_against_remote_control_plane(tmp_home, tmp_path, monkeypatch):
         assert res.exit_code != 0 and "remote control plane" in res.output
 
 
+@pytest.mark.slow
 def test_restart_of_sweep_sweeps_again(tmp_home, tmp_path):
     """ops restart of a sweep run must run a SWEEP again — the clone used
     to drop the matrix and silently train one default-params run."""

@@ -249,6 +249,7 @@ def test_seq2seq_trains_tp_mesh(tmp_home):
     assert result.history[-1]["loss"] == result.history[-1]["loss"]
 
 
+@pytest.mark.slow
 def test_fused_lm_loss_matches_regular_training():
     """fused_lm_loss=True (chunked head+CE, no [B,S,V] logits) trains to
     the same losses as the regular path — same seed, same data."""

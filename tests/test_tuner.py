@@ -789,6 +789,7 @@ def test_stopped_sweep_settles_stopped(tmp_home, tmp_path):
     assert store.get_status(sweep_uuid)["status"] == "stopped"
 
 
+@pytest.mark.slow
 def test_stop_during_final_batch_settles_stopped(tmp_home, tmp_path):
     """A stop that lands DURING the last batch (loop exits via mgr.done
     without re-reaching the stop check) must still settle STOPPED — the
