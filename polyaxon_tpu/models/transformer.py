@@ -190,25 +190,31 @@ class Attention(nn.Module):
                 )
                 cached_k.value, cached_v.value = k_all, v_all
                 cache_index.value = pos + S
-                if nkv != nh:
-                    rep = nh // nkv
-                    k_all = jnp.repeat(k_all, rep, axis=2)
-                    v_all = jnp.repeat(v_all, rep, axis=2)
-                # query row i may see cache positions <= pos + i
+                # Scores straight against the grouped cache: the full-cache
+                # K/V read dominates each decode step, and expanding it
+                # (jnp.repeat) multiplied that read by nh/nkv for identical
+                # math. Head order h = kv*G + g matches repeat's; MHA is
+                # just G == 1 through the same einsums.
+                G = nh // nkv
                 scores = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q, k_all,
+                    "bqkgd,bskd->bkgqs",
+                    q.reshape(B, S, nkv, G, hd),
+                    k_all,
                     preferred_element_type=jnp.float32,
-                ) / np.sqrt(hd)
+                ).reshape(B, nh, S, cfg.seq_len) / np.sqrt(hd)
+                # query row i may see cache positions <= pos + i
                 live = (
                     jnp.arange(cfg.seq_len)[None, :]
                     <= (pos + jnp.arange(S))[:, None]
                 )
                 scores = jnp.where(live[None, None, :, :], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-                out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
-                return _proj(cfg, cfg.dim, "o_proj")(
-                    out.reshape(B, S, nh * hd)
-                )
+                out = jnp.einsum(
+                    "bkgqs,bskd->bqkgd",
+                    probs.reshape(B, nkv, G, S, cfg.seq_len),
+                    v_all,
+                ).reshape(B, S, nh * hd)
+                return _proj(cfg, cfg.dim, "o_proj")(out)
             # cache creation pass (first mutable apply): fall through to the
             # ordinary full-sequence attention so output shapes are normal
 
