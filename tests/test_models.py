@@ -320,3 +320,46 @@ def test_fused_linear_masked_lm_matches_reference():
     g2 = jax.grad(fused, argnums=(0, 1))(f, k)
     for a, b, n in zip(g1, g2, ("dfeatures", "dkernel")):
         np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6, err_msg=n)
+
+
+@pytest.mark.slow
+def test_fused_lm_loss_tied_embeddings_matches_regular():
+    """fused_lm_loss with tie_embeddings: kernel = embedding.T — same
+    trajectories as the regular tied path."""
+    import numpy as np
+
+    from polyaxon_tpu.runtime.trainer import Trainer
+    from polyaxon_tpu.schemas.run_kinds import (
+        V1DataSpec,
+        V1ModelSpec,
+        V1OptimizerSpec,
+        V1Program,
+        V1TrainSpec,
+    )
+
+    def prog(fused):
+        return V1Program(
+            model=V1ModelSpec(
+                name="transformer_lm",
+                config={
+                    "preset": "tiny", "seq_len": 64, "n_layers": 2,
+                    "dim": 64, "vocab_size": 300, "tie_embeddings": True,
+                    "fused_lm_loss": fused, "fused_loss_chunk": 128,
+                },
+            ),
+            data=V1DataSpec(
+                name="synthetic_text", batch_size=8,
+                config={"seq_len": 64, "vocab_size": 300},
+            ),
+            optimizer=V1OptimizerSpec(name="adamw", learning_rate=1e-3),
+            train=V1TrainSpec(steps=3, log_every=1, precision="float32",
+                              seed=0),
+        )
+
+    import jax
+
+    r_reg = Trainer(prog(False), devices=jax.devices()[:1]).run()
+    r_fused = Trainer(prog(True), devices=jax.devices()[:1]).run()
+    for a, b in zip(r_reg.history, r_fused.history):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=2e-5,
+                                   err_msg=str((a, b)))
