@@ -203,8 +203,11 @@ def _main_container(
                 {"name": "MEGASCALE_NUM_SLICES", "value": str(n_slices)},
                 {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
                 {
+                    # pinned port (coordinator+1): relying on libtpu's
+                    # built-in default only works while nothing else claims
+                    # it and the default never moves across libtpu versions
                     "name": "MEGASCALE_COORDINATOR_ADDRESS",
-                    "value": f"{coord_pod}.{svc}",
+                    "value": f"{coord_pod}.{svc}:{port + 1}",
                 },
                 {
                     "name": "JAX_PROCESS_ID_BASE",
@@ -215,7 +218,12 @@ def _main_container(
             else []
         ),
         "volumeMounts": [CONTEXT_MOUNT, ARTIFACTS_MOUNT],
-        "ports": [{"containerPort": port, "name": "coordinator"}],
+        "ports": [{"containerPort": port, "name": "coordinator"}]
+        + (
+            [{"containerPort": port + 1, "name": "megascale"}]
+            if n_slices > 1
+            else []
+        ),
     }
     if tpu is not None:
         container["resources"] = {
@@ -300,7 +308,12 @@ def convert_jaxjob(
         "spec": {
             "clusterIP": "None",  # headless: stable per-pod DNS for rendezvous
             "selector": {"polyaxon/run-uuid": compiled.run_uuid},
-            "ports": [{"port": coordinator_port, "name": "coordinator"}],
+            "ports": [{"port": coordinator_port, "name": "coordinator"}]
+            + (
+                [{"port": coordinator_port + 1, "name": "megascale"}]
+                if n_slices > 1
+                else []
+            ),
         },
     }
     term = compiled.component.termination

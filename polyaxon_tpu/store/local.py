@@ -162,13 +162,12 @@ class RunStore:
             or (spec.get("operation") or {}).get("matrix")
         )
         if is_sweep:
+            # list_runs() already folds status meta into each row — filter
+            # on it directly instead of re-reading status.json per run
             children = [
                 rec["uuid"]
                 for rec in self.list_runs()
-                if (self.get_status(rec["uuid"]).get("meta") or {}).get(
-                    "sweep"
-                )
-                == run_uuid
+                if (rec.get("meta") or {}).get("sweep") == run_uuid
             ]
             if children:
                 if not cascade:

@@ -40,6 +40,28 @@ def _json_bytes(data) -> bytes:
     return json.dumps(data, default=str).encode()
 
 
+class BadParam(Exception):
+    """Client-side bad query param → 400.
+
+    Deliberately NOT a ValueError: json.JSONDecodeError subclasses
+    ValueError, so a blanket ValueError→400 would report corrupt stored
+    files (a server fault worth retrying/alerting on) as the client's
+    mistake.
+    """
+
+
+def _query_int(query: dict, name: str, default: int) -> int:
+    raw = query.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise BadParam(
+            f"query param {name!r} must be an integer, got {raw!r}"
+        ) from None
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: RunStore  # injected by make_server
 
@@ -85,7 +107,7 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send(200, _json_bytes(store.get_status(uuid)))
                 if sub == "logs":
                     text = store.read_logs(uuid)
-                    offset = int(query.get("offset", 0))
+                    offset = _query_int(query, "offset", 0)
                     chunk = text[offset:]
                     body = _json_bytes(
                         {"logs": chunk, "offset": offset + len(chunk)}
@@ -93,9 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send(200, body)
                 if sub == "metrics":
                     rows = store.read_metrics(uuid)
-                    tail = query.get("tail")
-                    if tail:  # bounded responses for pollers (dashboard)
-                        rows = rows[-max(1, int(tail)):]
+                    if "tail" in query:  # bounded responses for pollers
+                        rows = rows[-max(1, _query_int(query, "tail", 1)):]
                     return self._send(200, _json_bytes(rows))
                 if sub == "events":
                     return self._send(200, _json_bytes(store.read_events(uuid)))
@@ -130,6 +151,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._not_found(parsed.path)
         except KeyError as e:
             self._not_found(str(e))
+        except BadParam as e:
+            self._send(400, _json_bytes({"error": str(e)}))
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             self._send(500, _json_bytes({"error": str(e)}))
 

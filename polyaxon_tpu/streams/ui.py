@@ -157,13 +157,16 @@ async function detail() {
     };
   } else { act.innerHTML = ""; }
 
-  // sparkline per numeric metric key (system-monitor counters excluded)
+  // sparkline per numeric metric key; training keys first so sys.* monitor
+  // counters can't crowd loss curves out of the 8-chart cap
   const keys = new Set();
   for (const m of metrics) for (const k of Object.keys(m))
     if (k !== "step" && k !== "ts" && typeof m[k] === "number") keys.add(k);
+  const ordered = [...keys].sort((a, b) =>
+    (a.startsWith("sys.") - b.startsWith("sys.")) || a.localeCompare(b));
   const charts = document.getElementById("charts");
   charts.innerHTML = "";
-  for (const k of [...keys].slice(0, 8)) {
+  for (const k of ordered.slice(0, 8)) {
     const pts = metrics.filter(m => typeof m[k] === "number")
                        .map(m => [m.step ?? 0, m[k]]);
     if (!pts.length) continue;

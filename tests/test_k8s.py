@@ -212,6 +212,14 @@ def test_jaxjob_multislice_renders_one_job_per_slice(tmp_path, tmp_home):
         assert env["JAX_NUM_PROCESSES"] == "16"
         assert env["MEGASCALE_NUM_SLICES"] == "2"
         assert env["MEGASCALE_SLICE_ID"] == str(slice_id)
+        # megascale gets an explicit pinned port (coordinator+1), exposed
+        # on the container and the headless service — libtpu's built-in
+        # default is not contractual across versions
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":12356")
+        port_names = {p["name"]: p["containerPort"] for p in main["ports"]}
+        assert port_names["megascale"] == 12356
+        svc_ports = {p["name"]: p["port"] for p in service["spec"]["ports"]}
+        assert svc_ports["megascale"] == 12356
         args = main["args"]
         assert "--total-processes" in args
         assert args[args.index("--total-processes") + 1] == "16"

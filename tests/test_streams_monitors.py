@@ -76,6 +76,27 @@ def test_streams_404_and_traversal_guard(tmp_home):
         assert raised
 
 
+def test_streams_bad_int_params_are_400(tmp_home):
+    store = RunStore()
+    uuid = _seed_run(store)
+    with BackgroundServer(store) as srv:
+        for path in (
+            f"/runs/{uuid}/logs?offset=abc",
+            f"/runs/{uuid}/metrics?tail=xyz",
+        ):
+            try:
+                urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}")
+                code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+                body = json.loads(e.read())
+                assert "must be an integer" in body["error"]
+            assert code == 400
+        # well-formed params still work
+        code, rows = _get(srv.port, f"/runs/{uuid}/metrics?tail=1")
+        assert code == 200 and len(rows) == 1
+
+
 def test_host_metrics_present():
     m = host_metrics()
     assert "sys.cpu_percent" in m and "sys.memory_percent" in m
