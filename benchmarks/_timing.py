@@ -1,20 +1,39 @@
 """Shared timing helper for the benchmark scripts (one methodology:
-warmup call excluded, mean over iters, device-synced)."""
+warmup call excluded, mean over iters, device-synced).
+
+Sync is a scalar FETCH, not jax.block_until_ready: under the axon TPU
+tunnel block_until_ready returns before the device work finishes
+(measured r5: 0.5 ms/call "timing" vs 221 ms/call real for a seq-4096
+attention), silently inflating every number. Pulling one element of the
+output forces completion of the whole dependency chain. The fetch's own
+round-trip is measured afterwards (everything already done) and
+subtracted, so tunnel latency doesn't bill against the kernel."""
 
 from __future__ import annotations
 
 import time
 
 
+def _sync(out) -> float:
+    """Force completion of `out`'s computation: fetch one element."""
+    import jax
+
+    leaf = jax.tree.leaves(out)[0]
+    return float(leaf.ravel()[0])
+
+
 def time_call(fn, *args, iters: int = 20) -> float:
     """Mean wall time per call over `iters` calls; one warmup call runs
     first so compile time is excluded."""
-    import jax
-
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    _sync(out)
+    dt = time.perf_counter() - t0
+    # fetch round-trip with no pending work — pure tunnel/transfer cost
+    t0 = time.perf_counter()
+    _sync(out)
+    rtt = time.perf_counter() - t0
+    return max(dt - rtt, 1e-9) / iters

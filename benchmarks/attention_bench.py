@@ -9,7 +9,12 @@ context obligation): the XLA path materializes the [B,H,S,S] score matrix
   python benchmarks/attention_bench.py            # default sweep
   python benchmarks/attention_bench.py 1024 8192  # explicit seq lengths
 
-Prints one JSON line per (seq, backend, mode) with tokens/sec and ms/call.
+On TPU each seq also runs a grouped-query config (kv_heads = heads/4) —
+the flash kernel consumes grouped KV natively via its grid index maps, so
+this is the compiled-Mosaic validation of those grids on real hardware.
+
+Prints one JSON line per (seq, kv_heads, backend, mode) with tokens/sec
+and ms/call; schema pinned by tests/test_benchmarks.py.
 """
 
 from __future__ import annotations
@@ -43,18 +48,26 @@ def main():
     batch, heads, head_dim = 4, 16, 128
     on_tpu = device.platform == "tpu"
     backends = ("xla", "flash")
+    kv_sweep = (heads, heads // 4)
     if not on_tpu:
         # CPU runs the Pallas kernel in interpret mode (minutes per call) —
         # the backend comparison is only meaningful on the chip anyway
         seqs = [s for s in seqs if s <= 512]
-        batch, backends = 2, ("xla",)
+        batch, backends, kv_sweep = 2, ("xla",), (heads,)
 
     for seq in seqs:
+      for kv_heads in kv_sweep:
         key = jax.random.PRNGKey(0)
-        shape = (batch, seq, heads, head_dim)
-        q, k, v = (
-            jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
-            for i in range(3)
+        q = jax.random.normal(
+            key, (batch, seq, heads, head_dim), jnp.bfloat16
+        )
+        k, v = (
+            jax.random.normal(
+                jax.random.fold_in(key, i),
+                (batch, seq, kv_heads, head_dim),
+                jnp.bfloat16,
+            )
+            for i in (1, 2)
         )
         for backend in backends:
             try:
@@ -84,9 +97,11 @@ def main():
                                 "mode": mode,
                                 "ms_per_call": round(dt * 1e3, 3),
                                 "tokens_per_sec": round(batch * seq / dt, 1),
+                                "platform": device.platform,
                                 "device_kind": device.device_kind,
                                 "batch": batch,
                                 "heads": heads,
+                                "kv_heads": kv_heads,
                                 "head_dim": head_dim,
                             }
                         ),
@@ -95,7 +110,12 @@ def main():
             except Exception as e:  # noqa: BLE001 — report, keep sweeping
                 print(
                     json.dumps(
-                        {"seq": seq, "backend": backend, "error": f"{type(e).__name__}: {e}"[:200]}
+                        {
+                            "seq": seq,
+                            "kv_heads": kv_heads,
+                            "backend": backend,
+                            "error": f"{type(e).__name__}: {e}"[:200],
+                        }
                     ),
                     flush=True,
                 )

@@ -1,8 +1,19 @@
 """Decode throughput: KV-cache generation tokens/sec on the current device.
 
 Measures the serving-side half of the framework (models/generate.py):
-prefill latency and steady-state decode tok/s for a chip-sized LM, plus
-beam-search overhead. Prints one JSON line per config.
+prefill latency and steady-state decode tok/s, swept over GQA ratios
+(n_kv_heads) and cache lengths, plus beam-search overhead on the base
+config. The GQA sweep is what prices the grouped decode cache
+(models/generate.py keeps K/V at kv width — cache bytes shrink by
+heads/n_kv_heads; the sweep shows what that buys in tok/s on real HBM).
+
+Prints one JSON line per config, schema pinned by
+tests/test_benchmarks.py::test_decode_bench_schema:
+
+  {"metric": "decode_tokens_per_sec", "value": N, "unit": "tok/s",
+   "platform": "...", "device_kind": "...", "n_heads": H, "n_kv_heads": K,
+   "cache_len": S, "kv_cache_bytes": B, "batch": b, "prompt_len": p,
+   "max_new": n, "prefill_ms": ..., "per_token_ms": ..., ...}
 
   python benchmarks/decode_bench.py            # default sweep
   POLYAXON_JAX_PLATFORM=cpu python benchmarks/decode_bench.py  # smoke
@@ -12,10 +23,46 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def kv_cache_bytes(cfg: dict, batch: int, cache_len: int) -> int:
+    """bf16 K+V cache footprint for a grouped cache held at kv width."""
+    head_dim = cfg["dim"] // cfg["n_heads"]
+    return 2 * 2 * cfg["n_layers"] * batch * cache_len * cfg["n_kv_heads"] * head_dim
+
+
+def sweep_configs(on_tpu: bool):
+    """(cfg, batch, prompt_len, max_new, is_base) per line. The base
+    config (first) also runs beam search; the rest isolate one axis:
+    GQA ratio at fixed cache_len, then cache_len at fixed GQA ratio."""
+    if on_tpu:
+        base = {
+            "dim": 2048, "n_layers": 8, "n_heads": 16, "n_kv_heads": 16,
+            "vocab_size": 32768, "seq_len": 2048,
+        }
+        batch, prompt_len, max_new = 8, 512, 256
+        kv_sweep = (8, 4, 1)
+        len_sweep = (4096, 8192)
+    else:
+        base = {
+            "dim": 128, "n_layers": 2, "n_heads": 4, "n_kv_heads": 4,
+            "vocab_size": 1024, "seq_len": 256,
+        }
+        batch, prompt_len, max_new = 2, 32, 16
+        kv_sweep = (1,)
+        len_sweep = (512,)
+    yield base, batch, prompt_len, max_new, True
+    for kv in kv_sweep:
+        cfg = dict(base, n_kv_heads=kv)
+        yield cfg, batch, prompt_len, max_new, False
+    for cache_len in len_sweep:
+        # long caches at the most-grouped ratio — the config a serving
+        # deployment would actually run; prompt fills half the cache
+        cfg = dict(base, n_kv_heads=kv_sweep[-1], seq_len=cache_len)
+        yield cfg, batch, cache_len // 2, max_new, False
 
 
 def main():
@@ -29,82 +76,87 @@ def main():
     from polyaxon_tpu.models import build_model
     from polyaxon_tpu.models.generate import beam_search, generate
 
+    from _timing import time_call
+
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
-    if on_tpu:
-        cfg = {
-            "dim": 2048, "n_layers": 8, "n_heads": 16, "n_kv_heads": 16,
-            "vocab_size": 32768, "seq_len": 2048,
-        }
-        batch, prompt_len, max_new = 8, 512, 256
-    else:
-        cfg = {
-            "dim": 128, "n_layers": 2, "n_heads": 4, "n_kv_heads": 4,
-            "vocab_size": 1024, "seq_len": 256,
-        }
-        batch, prompt_len, max_new = 2, 32, 16
-
-    bundle = build_model("transformer_lm", cfg)
-    rng = jax.random.PRNGKey(0)
-    params = bundle.module.init(
-        {"params": rng}, jnp.zeros((batch, 8), jnp.int32), train=False
-    )["params"]
-    params = jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16)
-        if jnp.issubdtype(x.dtype, jnp.floating)
-        else x,
-        params,
-    )
-    prompt = jax.random.randint(
-        rng, (batch, prompt_len), 0, cfg["vocab_size"], dtype=jnp.int32
-    )
-
-    from _timing import time_call
 
     def timed(fn, *args):
         return time_call(fn, *args, iters=3)
 
-    def gen_fn(n):
-        return jax.jit(
-            lambda p, pr, s: generate(
-                bundle.module, p, pr, max_new_tokens=n,
-                temperature=0.8, top_k=40, seed=s,
+    for cfg, batch, prompt_len, max_new, is_base in sweep_configs(on_tpu):
+        bundle = build_model("transformer_lm", cfg)
+        rng = jax.random.PRNGKey(0)
+        params = bundle.module.init(
+            {"params": rng}, jnp.zeros((batch, 8), jnp.int32), train=False
+        )["params"]
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+        prompt = jax.random.randint(
+            rng, (batch, prompt_len), 0, cfg["vocab_size"], dtype=jnp.int32
+        )
+
+        def gen_fn(n):
+            return jax.jit(
+                lambda p, pr, s: generate(
+                    bundle.module, p, pr, max_new_tokens=n,
+                    temperature=0.8, top_k=40, seed=s,
+                )
+            )
+
+        seed = jnp.asarray(0, jnp.int32)
+        # prefill cost = a 1-new-token generation; steady-state decode is
+        # the marginal cost of the remaining max_new-1 tokens
+        try:
+            dt_prefill = timed(gen_fn(1), params, prompt, seed)
+            dt = timed(gen_fn(max_new), params, prompt, seed)
+        except Exception as e:  # noqa: BLE001 — report, keep sweeping
+            print(json.dumps({
+                "metric": "decode_tokens_per_sec",
+                "n_kv_heads": cfg["n_kv_heads"], "cache_len": cfg["seq_len"],
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }), flush=True)
+            continue
+        decode_dt = max(dt - dt_prefill, 1e-9)
+        print(json.dumps({
+            "metric": "decode_tokens_per_sec",
+            "value": round(batch * (max_new - 1) / decode_dt, 1),
+            "unit": "tok/s",
+            "platform": device.platform,
+            "device_kind": device.device_kind,
+            "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
+            "n_heads": cfg["n_heads"],
+            "n_kv_heads": cfg["n_kv_heads"],
+            "cache_len": cfg["seq_len"],
+            "kv_cache_bytes": kv_cache_bytes(cfg, batch, cfg["seq_len"]),
+            "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
+            "prefill_ms": round(dt_prefill * 1e3, 2),
+            "per_token_ms": round(decode_dt / (max_new - 1) * 1e3, 3),
+            "end_to_end_s": round(dt, 3),
+        }), flush=True)
+
+        if not is_base:
+            continue
+        nb = 4
+        b = jax.jit(
+            lambda p, pr: beam_search(
+                bundle.module, p, pr, max_new_tokens=max_new, num_beams=nb,
             )
         )
-
-    seed = jnp.asarray(0, jnp.int32)
-    # prefill cost = a 1-new-token generation; steady-state decode is the
-    # marginal cost of the remaining max_new-1 tokens
-    dt_prefill = timed(gen_fn(1), params, prompt, seed)
-    dt = timed(gen_fn(max_new), params, prompt, seed)
-    decode_dt = max(dt - dt_prefill, 1e-9)
-    print(json.dumps({
-        "metric": "decode_tokens_per_sec",
-        "value": round(batch * (max_new - 1) / decode_dt, 1),
-        "unit": "tok/s",
-        "device_kind": device.device_kind,
-        "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
-        "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
-        "prefill_ms": round(dt_prefill * 1e3, 2),
-        "per_token_ms": round(decode_dt / (max_new - 1) * 1e3, 3),
-        "end_to_end_s": round(dt, 3),
-    }), flush=True)
-
-    nb = 4
-    b = jax.jit(
-        lambda p, pr: beam_search(
-            bundle.module, p, pr, max_new_tokens=max_new, num_beams=nb,
-        )
-    )
-    dtb = timed(b, params, prompt)
-    print(json.dumps({
-        "metric": "beam4_decode_tokens_per_sec",
-        "value": round(batch * max_new / dtb, 1),
-        "unit": "tok/s",
-        "device_kind": device.device_kind,
-        "beams": nb,
-        "vs_sampling": round(dt / dtb, 3),
-    }), flush=True)
+        dtb = timed(b, params, prompt)
+        print(json.dumps({
+            "metric": "beam4_decode_tokens_per_sec",
+            "value": round(batch * max_new / dtb, 1),
+            "unit": "tok/s",
+            "platform": device.platform,
+            "device_kind": device.device_kind,
+            "beams": nb,
+            "vs_sampling": round(dt / dtb, 3),
+        }), flush=True)
 
 
 if __name__ == "__main__":

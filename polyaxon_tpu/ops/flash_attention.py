@@ -80,7 +80,7 @@ def _fwd_kernel(
     def _():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_kv, group=1):
@@ -104,11 +104,15 @@ def _fwd(q, k, v, causal, scale, block_q, block_kv, group=1):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            # lse rides a trailing singleton dim: Mosaic requires the last
+            # two block dims divisible by (8, 128) OR equal to the array's
+            # — (block_q, 1) on a [BH, S, 1] array satisfies that without
+            # the official kernel's 128x lane-broadcast duplication
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -151,13 +155,13 @@ def _dq_kernel(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])  # lse block [bq, 1] broadcasts over kv
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -204,7 +208,7 @@ def _dkv_kernel(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # [bq, bkv]
+        p = jnp.exp(s - lse_ref[0])  # [bq, bkv] via [bq, 1] lane broadcast
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -215,7 +219,7 @@ def _dkv_kernel(
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0],
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -240,13 +244,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, group):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, group, res, do):
+def _bwd_impl(q, k, v, o, lse, do, delta, causal, scale, block_q, block_kv, group):
+    """Shared dq/dk/dv kernels (FA-2 recipe). `delta` is the per-row
+    correction term — rowsum(do*o) for the plain vjp; callers that also
+    have an lse cotangent fold it in as rowsum(do*o) - dlse, which is all
+    d lse/d s = p costs (see _flash_lse_bwd)."""
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, o, lse = res
     BH, S, D = q.shape
     nq, nk = S // block_q, S // block_kv
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     common = dict(scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
     dq = pl.pallas_call(
@@ -257,8 +263,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, group, res, do):
             pl.BlockSpec((1, block_kv, D), lambda b, i, j, g=group: (b // g, j, 0)),
             pl.BlockSpec((1, block_kv, D), lambda b, i, j, g=group: (b // g, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -276,10 +282,10 @@ def _flash_bwd(causal, scale, block_q, block_kv, group, res, do):
             pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
             pl.BlockSpec((1, block_q, D),
                          lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n, 0)),
-            pl.BlockSpec((1, block_q),
-                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n)),
-            pl.BlockSpec((1, block_q),
-                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, t, g=group, n=nq: (b * g + t // n, t % n, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
@@ -298,10 +304,93 @@ def _flash_bwd(causal, scale, block_q, block_kv, group, res, do):
     return dq, dk, dv
 
 
+def _flash_bwd(causal, scale, block_q, block_kv, group, res, do):
+    q, k, v, o, lse = res
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BH, S, 1] — same trailing-singleton layout as lse
+    return _bwd_impl(
+        q, k, v, o, lse, do, delta, causal, scale, block_q, block_kv, group
+    )
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# lse-returning variant: ring attention merges per-hop outputs with the
+# online-softmax rule, which needs each hop's logsumexp — and its backward
+# needs the lse cotangent folded into delta (d lse/d s = p, so the dlse
+# term rides the same p·(dp − delta) expression the kernels already
+# compute; only `delta` changes, not the kernels).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_kv, group):
+    return _fwd(q, k, v, causal, scale, block_q, block_kv, group)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, group):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_kv, group)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_kv, group, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    if not isinstance(dlse, jax.custom_derivatives.SymbolicZero):
+        delta = delta - dlse.astype(jnp.float32)
+    return _bwd_impl(
+        q, k, v, o, lse, do, delta, causal, scale, block_q, block_kv, group
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # ------------------------------------------------------------------ public api
+def flash_shapes_ok(seq: int, block_q: int = 128, block_kv: int = 128) -> bool:
+    """True when `seq` satisfies the kernel's block layout (the same
+    checks flash_attention enforces, as a predicate for dispatch code):
+    seq divides into both (clamped) blocks, and each block is either the
+    whole sequence or sublane-aligned (Mosaic: multiple of 8)."""
+    bq, bkv = min(block_q, seq), min(block_kv, seq)
+    if seq % bq or seq % bkv:
+        return False
+    return all(b == seq or b % 8 == 0 for b in (bq, bkv))
+
+
+def flash_attention_lse(
+    q, k, v, *, causal=True, block_q=128, block_kv=128, sm_scale=None
+):
+    """flash_attention that also returns the logsumexp: (o [B,S,H,D],
+    lse [B,H,S] f32). The lse is differentiable (its cotangent folds into
+    the delta term of the shared backward kernels) — ring attention's
+    cross-hop online-softmax merge depends on that."""
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KV}")
+    group = H // KV
+    block_q = min(block_q, S)
+    block_kv = min(block_kv, S)
+    if S % block_q or S % block_kv:
+        raise ValueError(f"seq len {S} not divisible by blocks {block_q}/{block_kv}")
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+
+    def to_bh(x):
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
+
+    o, lse = _flash_lse(
+        to_bh(q), to_bh(k), to_bh(v), causal, scale, block_q, block_kv, group
+    )
+    return (
+        o.reshape(B, H, S, D).transpose(0, 2, 1, 3),
+        lse.reshape(B, H, S),
+    )
+
+
 def flash_attention(
     q, k, v, *, causal=True, block_q=128, block_kv=128, sm_scale=None
 ):

@@ -124,6 +124,49 @@ def _ring_body(q, k, v, axis_name: str, n: int, scale: float, causal: bool):
     return (o / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-30)).astype(q.dtype)
 
 
+def _ring_body_flash(q, k, v, axis_name: str, n: int, scale: float, causal: bool):
+    """Ring body with the Pallas flash kernel inside each hop.
+
+    The einsum body above materializes a [B,H,S_local,S_local] f32 score
+    matrix per hop — at the examples/longcontext.yaml shape (32k over a
+    4-way ring) that's a multi-hundred-MB HBM intermediate. Here each
+    hop runs the blockwise kernel (O(S_local) memory) and hops merge by
+    logsumexp:  lse = logaddexp(lse_a, lse_b);
+                o   = o_a·exp(lse_a−lse) + o_b·exp(lse_b−lse).
+
+    Hop provenance is static in t only at t=0 (own chunk → causal
+    kernel). Later hops come from another rank: earlier ranks attend in
+    full, later ranks contribute nothing — that predicate depends on
+    axis_index, so the kernel always runs non-causal and a skipped hop's
+    lse is masked to −inf, zeroing its merge weight. Same compute as the
+    masked einsum (SPMD uniformity), none of its memory."""
+    from ..ops.flash_attention import flash_attention_lse
+
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    o, lse = flash_attention_lse(q, k, v, causal=causal, sm_scale=scale)
+    o = o.astype(jnp.float32)  # merge in f32; cast once at the end
+    lse = lse[..., None]  # [B,H,S,1]
+    for t in range(1, n):
+        k, v = jax.lax.ppermute((k, v), axis_name, perm)
+        src = (idx - t) % n
+        o_i, lse_i = flash_attention_lse(q, k, v, causal=False, sm_scale=scale)
+        if causal:
+            # chunks from later ranks are fully masked: −inf lse ⇒ zero
+            # merge weight (exp(−inf − lse_new) = 0)
+            keep = (src < idx)[None, None, None, None]
+            lse_i = jnp.where(keep, lse_i[..., None], NEG_INF)
+        else:
+            lse_i = lse_i[..., None]
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w = jnp.exp(lse - lse_new).transpose(0, 2, 1, 3)  # [B,S,H,1]
+        w_i = jnp.exp(lse_i - lse_new).transpose(0, 2, 1, 3)
+        o = o * w + o_i.astype(jnp.float32) * w_i
+        lse = lse_new
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q, k, v, *, axis_name: str = "context", block_kv: int = 512, causal: bool = True
 ):
@@ -168,9 +211,19 @@ def ring_attention(
         # correct, just without the grouped-kv ring-traffic saving
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)
+    # the Pallas kernel runs inside each hop whenever the per-device
+    # sequence chunk fits its block layout — the einsum body (O(S_local^2)
+    # HBM per hop) is only the fallback for odd shapes
+    from ..ops.flash_attention import flash_shapes_ok
+    from .sharding import shard_map_nocheck
+
+    s_local = q.shape[1] // n
+    use_flash = flash_shapes_ok(s_local)
+    body = _ring_body_flash if use_flash else _ring_body
     q_spec = P(batch, axis_name, head, None)
-    inner = shard_map(
-        partial(_ring_body, axis_name=axis_name, n=n, scale=scale, causal=causal),
+    make = shard_map_nocheck if use_flash else partial(shard_map)
+    inner = make(
+        partial(body, axis_name=axis_name, n=n, scale=scale, causal=causal),
         mesh=mesh,
         in_specs=(q_spec, q_spec, q_spec),
         out_specs=q_spec,

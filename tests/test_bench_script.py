@@ -58,7 +58,40 @@ def test_decode_bench_emits_json(tmp_home):
     assert "decode_tokens_per_sec" in metrics
     assert "beam4_decode_tokens_per_sec" in metrics
     for r in recs:
+        assert "error" not in r, r
         assert r["value"] > 0, r
+        assert r["platform"] in ("cpu", "tpu")
+    decode = [r for r in recs if r["metric"] == "decode_tokens_per_sec"]
+    # the sweep must characterize the grouped cache: at least one GQA row
+    # (kv < q heads) and one extended-cache row, each pricing its cache
+    for r in decode:
+        assert {"n_kv_heads", "cache_len", "kv_cache_bytes"} <= r.keys(), r
+        assert r["kv_cache_bytes"] > 0
+    assert any(r["n_kv_heads"] < r["n_heads"] for r in decode)
+    base_len = decode[0]["cache_len"]
+    assert any(r["cache_len"] > base_len for r in decode)
+    # grouping shrinks the cache: bytes scale with n_kv_heads at equal len
+    by_len = [r for r in decode if r["cache_len"] == base_len]
+    mha = max(by_len, key=lambda r: r["n_kv_heads"])
+    gqa = min(by_len, key=lambda r: r["n_kv_heads"])
+    assert gqa["kv_cache_bytes"] * mha["n_kv_heads"] == pytest.approx(
+        mha["kv_cache_bytes"] * gqa["n_kv_heads"]
+    )
+
+
+def test_attention_bench_emits_json(tmp_home):
+    proc = _run("benchmarks/attention_bench.py", {})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [
+        json.loads(l)
+        for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    ]
+    assert recs
+    for r in recs:
+        assert "error" not in r, r
+        assert {"seq", "backend", "mode", "kv_heads", "platform"} <= r.keys(), r
+        assert r["tokens_per_sec"] > 0
 
 
 def test_update_baseline_md_sections_merge_and_skip(tmp_path, monkeypatch):
