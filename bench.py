@@ -395,6 +395,10 @@ def _run_pair(env_extra: dict, deadline_at: float):
             "device_kind", "platform", "model", "bare_tokens_per_sec",
         )
     }
+    if bare["batch"] != fw["batch"]:
+        # retry loop exhausted without converging: the ratio above compares
+        # unequal batches — flag it instead of publishing it as clean
+        out["batch_mismatch"] = [fw["batch"], bare["batch"]]
     return out, None
 
 

@@ -15,7 +15,15 @@ import time
 
 
 def _sync(out) -> float:
-    """Force completion of `out`'s computation: fetch one element."""
+    """Force completion of `out`'s computation: fetch one element.
+
+    Assumes everything being timed flows into ONE jitted executable whose
+    outputs include this leaf: the fetch barriers that executable's whole
+    dependency chain because the device runs its program to completion
+    before materializing any output. Work dispatched by OTHER executables
+    (or donated-buffer side effects) is not ordered before this fetch — a
+    benchmark that interleaves several jit calls must fetch from the last
+    one, or fall back to jax.block_until_ready on all of them."""
     import jax
 
     leaf = jax.tree.leaves(out)[0]

@@ -1,0 +1,45 @@
+"""Deterministic fault injection for the run lifecycle.
+
+Three layers, all seedable so a failing scenario replays byte-for-byte:
+
+- `FaultPlan` / `Fault` (plan.py): declarative, seed-derived schedules of
+  process-level faults bound to named injection points.
+- `arm`/`active`/`inject` (injector.py): the failpoint machinery the
+  runtime's instrumented sites consult — a no-op unless a plan is armed.
+- Cluster wrappers (cluster.py): `FlakyCluster`, `PartitionedCluster`,
+  `PreemptingCluster` compose over any ClusterClient;
+  `ScriptedCluster` is the self-driving fake they usually wrap.
+"""
+
+from .cluster import (
+    FlakyCluster,
+    PartitionedCluster,
+    PreemptingCluster,
+    ScriptedCluster,
+)
+from .injector import (
+    ChaosError,
+    SimulatedKill,
+    active,
+    arm,
+    corrupt_checkpoint,
+    disarm,
+    inject,
+)
+from .plan import Fault, FaultPlan
+
+__all__ = [
+    "ChaosError",
+    "Fault",
+    "FaultPlan",
+    "FlakyCluster",
+    "PartitionedCluster",
+    "PreemptingCluster",
+    "ScriptedCluster",
+    "SimulatedKill",
+    "active",
+    "arm",
+    "corrupt_checkpoint",
+    "disarm",
+    "inject",
+]

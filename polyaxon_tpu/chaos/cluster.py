@@ -1,0 +1,202 @@
+"""Chaos wrappers over the `ClusterClient` protocol.
+
+Each wrapper composes with any ClusterClient (the reconciler's injectable
+three-verb contract: submit/status/delete), so scenarios stack:
+
+    FlakyCluster(PreemptingCluster(ScriptedCluster(...)), seed=7)
+
+All randomness is string-seeded at construction — the same seed replays
+the same error schedule. `ScriptedCluster` is the self-driving in-memory
+fake these wrappers are usually aimed at: a submitted gang advances
+Pending → Running → Succeeded over successive polls without the test
+hand-editing pod phases.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..retry import TransientError
+
+
+class ScriptedCluster:
+    """Self-driving fake cluster: pods march Pending → Running → Succeeded.
+
+    A gang spends `pending_polls` status calls Pending, then `running_polls`
+    Running, then reports Succeeded. Gang size comes from the manifests'
+    Job completions (one pod per completion), like a real apiserver view.
+    delete() drops the pods; resubmitting restarts the script from Pending
+    — exactly the surface the reconciler's gang-restart path needs."""
+
+    def __init__(self, *, pending_polls: int = 1, running_polls: int = 2):
+        self.pending_polls = pending_polls
+        self.running_polls = running_polls
+        self.submitted: dict[str, list[dict]] = {}
+        self.pods: dict[str, list[dict]] = {}
+        self._polls: dict[str, int] = {}
+        self.deleted: list[str] = []
+
+    def submit(self, run_uuid: str, manifests: list[dict]) -> None:
+        self.submitted[run_uuid] = manifests
+        n = sum(
+            int((m.get("spec") or {}).get("completions") or 1)
+            for m in manifests
+            if m.get("kind") == "Job"
+        ) or 1
+        self.pods[run_uuid] = [
+            {"name": f"w-{i}", "phase": "Pending"} for i in range(n)
+        ]
+        self._polls[run_uuid] = 0
+
+    def status(self, run_uuid: str) -> dict:
+        pods = self.pods.get(run_uuid)
+        if pods is None:
+            return {"pods": []}
+        i = self._polls[run_uuid]
+        self._polls[run_uuid] = i + 1
+        if i >= self.pending_polls + self.running_polls:
+            phase = "Succeeded"
+        elif i >= self.pending_polls:
+            phase = "Running"
+        else:
+            phase = "Pending"
+        for p in pods:
+            p["phase"] = phase
+        return {"pods": [dict(p) for p in pods]}
+
+    def delete(self, run_uuid: str) -> None:
+        self.deleted.append(run_uuid)
+        self.pods.pop(run_uuid, None)
+        self._polls.pop(run_uuid, None)
+
+
+class FlakyCluster:
+    """Transient-error injector: every verb fails with `TransientError` on
+    a seeded Bernoulli schedule, capped at `max_consecutive` failures in a
+    row — so a caller whose error budget exceeds the cap is guaranteed to
+    make progress, and one whose budget is smaller is guaranteed to trip.
+    The error fires BEFORE the inner call: a failed verb has no effect,
+    like a connection refused at the socket."""
+
+    def __init__(
+        self,
+        inner,
+        *,
+        seed: int = 0,
+        rate: float = 0.3,
+        max_consecutive: int = 2,
+    ):
+        self.inner = inner
+        self.rate = rate
+        self.max_consecutive = max_consecutive
+        self._rng = random.Random(f"flaky:{seed}")
+        self._consecutive = 0
+        self.injected = 0
+
+    def _maybe_fail(self, verb: str, run_uuid: str) -> None:
+        if (
+            self._consecutive < self.max_consecutive
+            and self._rng.random() < self.rate
+        ):
+            self._consecutive += 1
+            self.injected += 1
+            raise TransientError(
+                f"chaos: injected {verb} flake for {run_uuid[:8]} "
+                f"(#{self.injected})"
+            )
+        self._consecutive = 0
+
+    def submit(self, run_uuid: str, manifests: list[dict]) -> None:
+        self._maybe_fail("submit", run_uuid)
+        return self.inner.submit(run_uuid, manifests)
+
+    def status(self, run_uuid: str) -> dict:
+        self._maybe_fail("status", run_uuid)
+        return self.inner.status(run_uuid)
+
+    def delete(self, run_uuid: str) -> None:
+        self._maybe_fail("delete", run_uuid)
+        return self.inner.delete(run_uuid)
+
+
+class PartitionedCluster:
+    """Network-partition window: calls `start ≤ i < start+length` (counted
+    across all verbs) see the partition — status() serves the last healthy
+    response (a stale view, what a caching proxy would return) and
+    submit/delete raise. Outside the window everything passes through."""
+
+    def __init__(self, inner, *, start: int = 0, length: int = 0):
+        self.inner = inner
+        self.start = start
+        self.length = length
+        self._calls = 0
+        self._last_status: dict[str, dict] = {}
+
+    def _partitioned(self) -> bool:
+        i = self._calls
+        self._calls += 1
+        return self.start <= i < self.start + self.length
+
+    def submit(self, run_uuid: str, manifests: list[dict]) -> None:
+        if self._partitioned():
+            raise TransientError("chaos: partition — submit unreachable")
+        return self.inner.submit(run_uuid, manifests)
+
+    def status(self, run_uuid: str) -> dict:
+        if self._partitioned():
+            stale = self._last_status.get(run_uuid)
+            if stale is not None:
+                return stale
+            raise TransientError("chaos: partition — status unreachable")
+        out = self.inner.status(run_uuid)
+        self._last_status[run_uuid] = out
+        return out
+
+    def delete(self, run_uuid: str) -> None:
+        if self._partitioned():
+            raise TransientError("chaos: partition — delete unreachable")
+        return self.inner.delete(run_uuid)
+
+
+class PreemptingCluster:
+    """Spot-reclaim injector: on seed-chosen status polls, the gang's pods
+    are reported Failed with reason=Preempted (the kubelet's view of a
+    reclaimed node). Only the VIEW is rewritten — delete/submit pass
+    through — so the reconciler's delete→drain→resubmit restart runs for
+    real against the inner cluster."""
+
+    def __init__(self, inner, *, preempt_polls: tuple[int, ...] = (),
+                 seed: Optional[int] = None, n_preemptions: int = 1,
+                 window: int = 8):
+        """Either pass explicit `preempt_polls` indices, or a `seed` to
+        draw `n_preemptions` distinct poll indices from [1, window)."""
+        self.inner = inner
+        if seed is not None:
+            rng = random.Random(f"preempt:{seed}")
+            preempt_polls = tuple(
+                sorted(rng.sample(range(1, window), n_preemptions))
+            )
+        self.preempt_polls = tuple(preempt_polls)
+        self._polls: dict[str, int] = {}
+        self.preempted = 0
+
+    def submit(self, run_uuid: str, manifests: list[dict]) -> None:
+        return self.inner.submit(run_uuid, manifests)
+
+    def status(self, run_uuid: str) -> dict:
+        out = self.inner.status(run_uuid)
+        i = self._polls.get(run_uuid, 0)
+        self._polls[run_uuid] = i + 1
+        if i in self.preempt_polls and out.get("pods"):
+            self.preempted += 1
+            out = {
+                "pods": [
+                    dict(p, phase="Failed", reason="Preempted")
+                    for p in out["pods"]
+                ]
+            }
+        return out
+
+    def delete(self, run_uuid: str) -> None:
+        return self.inner.delete(run_uuid)

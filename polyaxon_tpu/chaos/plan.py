@@ -1,0 +1,134 @@
+"""FaultPlan: a seeded, declarative schedule of faults.
+
+A scenario is a list of `Fault` entries bound to named injection points
+(`trainer.step`, `checkpoint.save`, cluster verbs). Everything random about
+a scenario — which step the kill lands on, where the preemption strikes —
+is drawn from a string-seeded PRNG at plan-construction time, so the same
+seed reproduces the same scenario byte-for-byte across processes (string
+seeding hashes via sha512; no dependence on PYTHONHASHSEED).
+
+The plan itself is inert data; `chaos.injector.arm(plan)` makes the
+runtime's instrumented points consult it, and the cluster wrappers in
+`chaos.cluster` take their own seeds directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault.
+
+    point:   injection-point name the fault is bound to.
+    action:  what to do when it fires — "raise" (TransientError),
+             "raise_permanent" (PermanentError), "kill" (simulated
+             SIGKILL: a mid-step process death), "sigterm" (real SIGTERM
+             to this process — the preemption grace notice), or
+             "corrupt_checkpoint" (scramble the just-written step).
+    at:      fire on the Nth hit of the point (0-based), when `step` is
+             not used for matching.
+    count:   how many times the fault fires before it is spent. A spent
+             fault never fires again — a kill on attempt 1 must not kill
+             the retry.
+    step:    when set, fire on the hit whose ctx carries this step value
+             (trainer-loop faults address steps, not call counts).
+    message: text carried by raised errors (shows up in run logs).
+    """
+
+    point: str
+    action: str
+    at: int = 0
+    count: int = 1
+    step: Optional[int] = None
+    message: str = "chaos: injected fault"
+
+    def _due(self, hit_index: int, ctx: dict) -> bool:
+        if self.count <= 0:
+            return False
+        if self.step is not None:
+            return ctx.get("step") == self.step
+        return self.at <= hit_index < self.at + self.count
+
+
+class FaultPlan:
+    """A reproducible fault scenario: faults + the seed that shaped them.
+
+    `params` records every seed-derived choice (kill step, preemption poll,
+    corrupted checkpoint step) so tests can assert exact recovery points
+    instead of guessing."""
+
+    def __init__(self, faults=(), *, seed: int = 0, params: Optional[dict] = None):
+        self.seed = seed
+        self.faults = list(faults)
+        self.params = dict(params or {})
+        self._hits: dict[str, int] = {}
+
+    def rng(self, salt: str) -> random.Random:
+        """Deterministic sub-stream for `salt` — injectors that need their
+        own randomness (stale-status choices etc.) derive it here so two
+        injectors never share (and thus perturb) one stream."""
+        return random.Random(f"{self.seed}:{salt}")
+
+    def fire(self, point: str, **ctx) -> Optional[Fault]:
+        """Record a hit of `point`; return the fault due now (consuming one
+        of its `count`), or None. At most one fault fires per hit."""
+        i = self._hits.get(point, 0)
+        self._hits[point] = i + 1
+        for fault in self.faults:
+            if fault.point == point and fault._due(i, ctx):
+                fault.count -= 1
+                return fault
+        return None
+
+    # ------------------------------------------------- canned scenarios
+    @classmethod
+    def kill_mid_run(cls, seed: int, steps: int, min_step: int = 1) -> "FaultPlan":
+        """Process dies mid-step, once: the kill step is seed-chosen in
+        [min_step, steps)."""
+        rng = random.Random(f"kill_mid_run:{seed}")
+        k = rng.randrange(min_step, steps)
+        return cls(
+            [Fault("trainer.step", "kill", step=k,
+                   message=f"chaos: process killed at step {k}")],
+            seed=seed,
+            params={"kill_step": k},
+        )
+
+    @classmethod
+    def preempt_mid_run(cls, seed: int, steps: int, min_step: int = 1) -> "FaultPlan":
+        """SIGTERM (preemption grace notice) lands mid-run, once."""
+        rng = random.Random(f"preempt_mid_run:{seed}")
+        k = rng.randrange(min_step, steps)
+        return cls(
+            [Fault("trainer.step", "sigterm", step=k)],
+            seed=seed,
+            params={"preempt_step": k},
+        )
+
+    @classmethod
+    def corrupt_then_kill(
+        cls, seed: int, steps: int, checkpoint_every: int
+    ) -> "FaultPlan":
+        """The newest checkpoint is corrupted the moment it lands, then the
+        process dies before the next one — resume must fall back to the
+        previous intact step. The corrupted step is a seed-chosen multiple
+        of `checkpoint_every` (≥ the second checkpoint, so a fallback
+        exists); the kill lands between it and the following save."""
+        rng = random.Random(f"corrupt_then_kill:{seed}")
+        ckpts = list(range(2 * checkpoint_every, steps, checkpoint_every))
+        c = rng.choice(ckpts)
+        k = rng.randrange(c, min(c + checkpoint_every, steps))
+        return cls(
+            [
+                Fault("checkpoint.save", "corrupt_checkpoint", step=c),
+                Fault("trainer.step", "kill", step=k,
+                      message=f"chaos: process killed at step {k}"),
+            ],
+            seed=seed,
+            params={"corrupt_step": c, "kill_step": k,
+                    "fallback_step": c - checkpoint_every},
+        )

@@ -19,11 +19,45 @@ import json
 import subprocess
 from typing import Optional
 
+from ..retry import RetryPolicy
+
 RUN_LABEL = "polyaxon/run-uuid"
+
+# stderr fragments that mean "the apiserver/network hiccupped", not "this
+# request is wrong" — the classic kubectl transport and throttling failures.
+# Anything else (NotFound, Forbidden, validation errors) is treated as
+# permanent: retrying a bad manifest only delays the real error.
+_TRANSIENT_PATTERNS = (
+    "connection refused",
+    "connection reset",
+    "i/o timeout",
+    "timed out",
+    "tls handshake",
+    "etcdserver",
+    "too many requests",
+    "service unavailable",
+    "server is currently unable",
+    "eof",
+)
 
 
 class ClusterError(RuntimeError):
-    """kubectl returned non-zero; carries the command and stderr tail."""
+    """kubectl failed; carries the command and stderr tail. `transient`
+    feeds the shared retry taxonomy (retry.classify): True for transport
+    flaps worth retrying, False for errors retries cannot fix."""
+
+    def __init__(self, message: str, *, transient: bool = True):
+        super().__init__(message)
+        self.transient = transient
+
+    @property
+    def permanent(self) -> bool:  # retry.classify reads this attribute
+        return not self.transient
+
+
+def _is_transient_stderr(stderr: str) -> bool:
+    low = (stderr or "").lower()
+    return any(p in low for p in _TRANSIENT_PATTERNS)
 
 
 class KubectlCluster:
@@ -35,12 +69,20 @@ class KubectlCluster:
         kubectl: str = "kubectl",
         dry_run: bool = False,
         timeout: float = 60.0,
+        retries: int = 2,
+        backoff: float = 0.5,
     ):
         self.namespace = namespace
         self.context = context
         self.kubectl = kubectl
         self.dry_run = dry_run
         self.timeout = timeout
+        # in-verb retries absorb short apiserver flaps so a single blip
+        # doesn't surface as a reconcile error; sustained outages still
+        # propagate and feed the reconciler's error budget
+        self._policy = RetryPolicy(
+            max_retries=int(retries), backoff=float(backoff)
+        )
 
     # ------------------------------------------------------------ plumbing
     def _base(self) -> list[str]:
@@ -50,6 +92,15 @@ class KubectlCluster:
         return cmd
 
     def _run(
+        self, args: list[str], stdin: Optional[str] = None
+    ) -> subprocess.CompletedProcess:
+        return self._policy.call(
+            lambda: self._run_once(args, stdin=stdin),
+            seed=" ".join(args[:3]),
+            retryable=lambda e: getattr(e, "transient", False),
+        )
+
+    def _run_once(
         self, args: list[str], stdin: Optional[str] = None
     ) -> subprocess.CompletedProcess:
         cmd = self._base() + args
@@ -62,17 +113,22 @@ class KubectlCluster:
                 timeout=self.timeout,
             )
         except FileNotFoundError as e:
+            # a missing binary never fixes itself mid-run
             raise ClusterError(
-                f"kubectl binary not found ({self.kubectl}): {e}"
+                f"kubectl binary not found ({self.kubectl}): {e}",
+                transient=False,
             ) from e
         except subprocess.TimeoutExpired as e:
             raise ClusterError(
-                f"kubectl timed out after {self.timeout}s: {' '.join(cmd)}"
+                f"kubectl timed out after {self.timeout}s: {' '.join(cmd)}",
+                transient=True,
             ) from e
         if proc.returncode != 0:
+            stderr = (proc.stderr or "").strip()
             raise ClusterError(
                 f"kubectl failed ({proc.returncode}): {' '.join(args[:3])}…: "
-                f"{(proc.stderr or '').strip()[-500:]}"
+                f"{stderr[-500:]}",
+                transient=_is_transient_stderr(stderr),
             )
         return proc
 

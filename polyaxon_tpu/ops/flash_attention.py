@@ -328,6 +328,8 @@ def _flash_lse(q, k, v, causal, scale, block_q, block_kv, group):
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, group):
+    # symbolic_zeros=True wraps each primal in CustomVJPPrimal
+    q, k, v = q.value, k.value, v.value
     o, lse = _fwd(q, k, v, causal, scale, block_q, block_kv, group)
     return (o, lse), (q, k, v, o, lse)
 
@@ -335,9 +337,13 @@ def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, group):
 def _flash_lse_bwd(causal, scale, block_q, block_kv, group, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
+    if isinstance(do, jax.custom_derivatives.SymbolicZero):
+        do = jnp.zeros(do.shape, do.dtype)
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )
+    # ring callers differentiate only through `o`, so dlse arrives as a
+    # SymbolicZero and the subtraction (and its zeros buffer) is skipped
     if not isinstance(dlse, jax.custom_derivatives.SymbolicZero):
         delta = delta - dlse.astype(jnp.float32)
     return _bwd_impl(
@@ -345,7 +351,7 @@ def _flash_lse_bwd(causal, scale, block_q, block_kv, group, res, cts):
     )
 
 
-_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd, symbolic_zeros=True)
 
 
 # ------------------------------------------------------------------ public api

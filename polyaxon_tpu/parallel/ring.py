@@ -218,7 +218,10 @@ def ring_attention(
     from .sharding import shard_map_nocheck
 
     s_local = q.shape[1] // n
-    use_flash = flash_shapes_ok(s_local)
+    # head_dim gate mirrors resolve_auto_backend (ops/attention.py): the
+    # kernel's lane layout needs D a multiple of 64 and within VMEM tiling
+    D = q.shape[-1]
+    use_flash = flash_shapes_ok(s_local) and D % 64 == 0 and D <= 256
     body = _ring_body_flash if use_flash else _ring_body
     q_spec = P(batch, axis_name, head, None)
     make = shard_map_nocheck if use_flash else partial(shard_map)

@@ -1,0 +1,140 @@
+"""Shared retry/backoff policy + failure taxonomy for the run lifecycle.
+
+One policy object serves every layer that restarts work — the executor's
+attempt loop, `KubectlCluster`'s kubectl verbs, and the reconciler's poll
+error budget — so backoff shape and retryable-vs-permanent classification
+cannot drift between them (the paper's §5 failure-detection story: a
+half-alive gang must fail fast, a transient flap must not burn the queue
+slot, a preemption must never consume the user's retry budget).
+
+Delays are deterministic given (seed, attempt): exponential growth capped
+at `backoff_max`, with jitter derived from a string-seeded PRNG (string
+seeding hashes via sha512, stable across processes and hash randomization)
+so chaos tests can reproduce exact retry spacing from a scenario seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional
+
+
+class TransientError(Exception):
+    """The operation failed for an environmental reason and is worth
+    retrying (network flap, apiserver hiccup, injected chaos fault)."""
+
+
+class PermanentError(Exception):
+    """The operation can never succeed by retrying (bad config, missing
+    binary, validation error) — retrying only burns budget and time."""
+
+
+class Preempted(TransientError):
+    """The machine went away under us (SIGTERM grace notice, spot slice
+    reclaim). Always retryable and NEVER consumes the retry budget: the
+    program was healthy, the infrastructure wasn't. Carries the last
+    checkpointed step when known so the restart can resume warm."""
+
+    def __init__(self, message: str = "preempted", step: Optional[int] = None):
+        super().__init__(message)
+        self.step = step
+
+
+PERMANENT = "permanent"
+TRANSIENT = "transient"
+PREEMPTED = "preempted"
+
+
+def classify(exc: BaseException) -> str:
+    """Failure class of an exception: PREEMPTED / PERMANENT / TRANSIENT.
+
+    Unknown exception types classify as TRANSIENT — the historical executor
+    behavior (retry everything up to maxRetries) is the safe default for
+    user programs, where a crash may be an OOM or a flaky data source.
+    Permanence is opted into: raise `PermanentError`, or set a truthy
+    `permanent` attribute on any exception type."""
+    if isinstance(exc, Preempted):
+        return PREEMPTED
+    if isinstance(exc, PermanentError):
+        return PERMANENT
+    if getattr(exc, "permanent", False):
+        return PERMANENT
+    return TRANSIENT
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter.
+
+    `backoff` is the initial delay; attempt `n` (0-based) waits
+    `min(backoff * backoff_factor**n, backoff_max)`, shrunk by up to
+    `jitter` fraction (seeded, so reproducible). backoff=0 means retry
+    immediately — the default, preserving spec files that set only
+    `maxRetries`."""
+
+    max_retries: int = 0
+    backoff: float = 0.0
+    backoff_factor: float = 2.0
+    backoff_max: float = 60.0
+    jitter: float = 0.1
+
+    @classmethod
+    def from_termination(cls, term) -> "RetryPolicy":
+        """Build from a V1Termination (or None → no retries)."""
+        if term is None:
+            return cls()
+
+        def _f(value, default):
+            return float(value) if value is not None else default
+
+        return cls(
+            max_retries=int(term.max_retries or 0),
+            backoff=_f(term.backoff, 0.0),
+            backoff_factor=_f(term.backoff_factor, 2.0),
+            backoff_max=_f(term.backoff_max, 60.0),
+            jitter=_f(term.jitter, 0.1),
+        )
+
+    def delay(self, attempt: int, *, seed: Optional[str] = None) -> float:
+        """Seconds to wait before retry `attempt` (0-based). Deterministic
+        for a given (seed, attempt) pair; jitter shrinks the delay by up to
+        `jitter` fraction so synchronized retries de-correlate without ever
+        exceeding the nominal exponential envelope."""
+        base = min(
+            self.backoff * self.backoff_factor ** max(attempt, 0),
+            self.backoff_max,
+        )
+        if base <= 0 or self.jitter <= 0:
+            return max(base, 0.0)
+        r = random.Random(f"{seed}:{attempt}").random()
+        return base * (1.0 - self.jitter * r)
+
+    def call(
+        self,
+        fn: Callable,
+        *,
+        seed: Optional[str] = None,
+        retryable: Callable[[BaseException], bool] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
+    ):
+        """Run `fn()` with this policy. `retryable(exc)` decides whether an
+        exception is worth another attempt (default: classify != PERMANENT);
+        `on_retry(attempt, delay, exc)` observes each backoff for logging."""
+        if retryable is None:
+            retryable = lambda e: classify(e) != PERMANENT  # noqa: E731
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if attempt >= self.max_retries or not retryable(e):
+                    raise
+                d = self.delay(attempt, seed=seed)
+                attempt += 1
+                if on_retry is not None:
+                    on_retry(attempt, d, e)
+                if d > 0:
+                    sleep(d)

@@ -46,8 +46,11 @@ def save_checkpoint(
 ):
     import orbax.checkpoint as ocp
 
+    from ..chaos.injector import inject
+
     mgr = _manager(directory, keep=keep)
     mgr.save(step, args=ocp.args.StandardSave(state))
+    inject("checkpoint.save", step=step, directory=directory, manager=mgr)
     if wait:
         mgr.wait_until_finished()
 
@@ -60,6 +63,13 @@ def latest_step(directory: str, keep: Optional[int] = None) -> Optional[int]:
     if not directory or not os.path.isdir(directory):
         return None
     return _manager(directory, keep=keep).latest_step()
+
+
+def all_steps(directory: str, keep: Optional[int] = None) -> list[int]:
+    """Available checkpoint steps, ascending (empty when no directory)."""
+    if not directory or not os.path.isdir(directory):
+        return []
+    return sorted(int(s) for s in _manager(directory, keep=keep).all_steps())
 
 
 def restore_checkpoint(directory: str, step: int, target, keep: Optional[int] = None):
@@ -75,6 +85,57 @@ def restore_checkpoint(directory: str, step: int, target, keep: Optional[int] = 
         target,
     )
     return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+
+def restore_latest_intact(
+    directory: str, target, keep: Optional[int] = None
+):
+    """Restore the newest checkpoint that deserializes cleanly.
+
+    A preemption or node loss can land mid-write (or, rarer, scramble the
+    bytes of a step that the metadata still lists). Try steps newest-first;
+    a step whose restore raises is QUARANTINED — its directory is renamed
+    to `<step>.corrupt` — so the manager stops listing it and a later
+    `save(step)` on the retry does not collide with the poisoned dir.
+
+    Returns (state, step, corrupt_steps): `(target, 0, [...])` when no
+    intact checkpoint exists (train from scratch)."""
+    corrupt: list[int] = []
+    mgr = _managers.get(os.path.abspath(directory))
+    if mgr is not None:
+        try:
+            # same-process restart: an async save may still be in flight —
+            # judging it mid-write would quarantine a good checkpoint
+            mgr.wait_until_finished()
+        except Exception:  # noqa: BLE001 — a failed flush just falls through
+            pass
+    for step in reversed(all_steps(directory, keep=keep)):
+        try:
+            state = restore_checkpoint(directory, step, target, keep=keep)
+            return state, step, corrupt
+        except Exception:  # noqa: BLE001 — any restore fault means fall back
+            corrupt.append(step)
+            _quarantine(directory, step, keep=keep)
+    return target, 0, corrupt
+
+
+def _quarantine(directory: str, step: int, keep: Optional[int] = None) -> None:
+    """Rename a poisoned step dir out of the manager's sight. The manager's
+    in-memory step cache is refreshed by `reload()` where available."""
+    src = os.path.join(os.path.abspath(directory), str(step))
+    dst = src + ".corrupt"
+    try:
+        if os.path.isdir(src) and not os.path.exists(dst):
+            os.rename(src, dst)
+    except OSError:
+        pass  # already renamed by a peer process, or FS refuses — best effort
+    mgr = _managers.get(os.path.abspath(directory))
+    reload_fn = getattr(mgr, "reload", None)
+    if reload_fn is not None:
+        try:
+            reload_fn()
+        except Exception:  # noqa: BLE001 — cache refresh is advisory
+            pass
 
 
 def close_all():

@@ -83,17 +83,21 @@ class Executor:
             if current != s and can_transition(current, s):
                 store.set_status(run_uuid, s)
 
+        from ..retry import PERMANENT, PREEMPTED, RetryPolicy, classify
+
         term = compiled.component.termination
-        max_retries = (term.max_retries if term and term.max_retries else 0) or 0
+        policy = RetryPolicy.from_termination(term)
+        max_retries = policy.max_retries
         timeout = term.timeout if term else None
 
-        attempt = 0
+        attempt = 0  # budgeted retries consumed (transient failures)
+        restarts = 0  # all restarts, including free preemption restarts
         while True:
             if self._stopped(run_uuid):  # stop landed between attempts
                 return V1Statuses.STOPPED
             store.set_status(run_uuid, V1Statuses.STARTING)
             try:
-                self._run_once(compiled, timeout=timeout, resume=attempt > 0)
+                self._run_once(compiled, timeout=timeout, resume=restarts > 0)
                 if self._stopped(run_uuid):  # stop raced the finish line
                     return V1Statuses.STOPPED
                 store.set_status(run_uuid, V1Statuses.SUCCEEDED)
@@ -109,11 +113,46 @@ class Executor:
                 if isinstance(e, KeyboardInterrupt):
                     store.request_stop(run_uuid)
                     raise
-                if attempt < max_retries:
-                    attempt += 1
-                    store.set_status(run_uuid, V1Statuses.RETRYING, reason=str(e))
+                kind = classify(e)
+                if kind == PREEMPTED:
+                    # the program was healthy; the machine went away. Restart
+                    # from checkpoint WITHOUT burning the retry budget.
+                    restarts += 1
+                    store.log_event(
+                        run_uuid,
+                        "preempted",
+                        {
+                            "step": getattr(e, "step", None),
+                            "restart": restarts,
+                        },
+                    )
+                    store.set_status(
+                        run_uuid, V1Statuses.RETRYING, reason="preempted",
+                        message=str(e),
+                    )
                     store.set_status(run_uuid, V1Statuses.QUEUED)
                     store.set_status(run_uuid, V1Statuses.SCHEDULED)
+                    continue
+                if kind != PERMANENT and attempt < max_retries:
+                    delay = policy.delay(attempt, seed=run_uuid)
+                    attempt += 1
+                    restarts += 1
+                    store.log_event(
+                        run_uuid,
+                        "retry",
+                        {"attempt": attempt, "delay": delay, "error": str(e)},
+                    )
+                    store.set_status(
+                        run_uuid,
+                        V1Statuses.RETRYING,
+                        reason=f"retry {attempt}/{max_retries}"
+                        + (f" after {delay:.3g}s" if delay > 0 else ""),
+                        message=str(e),
+                    )
+                    store.set_status(run_uuid, V1Statuses.QUEUED)
+                    store.set_status(run_uuid, V1Statuses.SCHEDULED)
+                    if delay > 0:
+                        time.sleep(delay)
                     continue
                 store.set_status(
                     run_uuid, V1Statuses.FAILED, reason=type(e).__name__, message=str(e)
@@ -505,7 +544,14 @@ class Executor:
             )
 
     def _run_program(self, compiled: CompiledOperation, resume: bool):
+        from . import preemption
         from .trainer import Trainer
+
+        # SIGTERM = preemption grace notice: the trainer loop observes the
+        # flag at step boundaries and checkpoints before exiting. Clear any
+        # stale flag from a previous attempt in this process.
+        preemption.install()
+        preemption.clear()
 
         run = compiled.run
         store, run_uuid = self.store, compiled.run_uuid
@@ -550,6 +596,7 @@ class Executor:
             devices=self.devices,
             slices=n_slices,
             log_fn=log_fn,
+            event_fn=lambda kind, body: store.log_event(run_uuid, kind, body),
             checkpoint_dir=ckpt_dir,
             artifacts_dir=str(store.outputs_dir(run_uuid)),
         )
@@ -638,6 +685,14 @@ class Executor:
             code = proc.wait()
         finally:
             os.unlink(spec_file.name)
+        if code in (75, 143):
+            # 75 = EX_TEMPFAIL: a worker caught SIGTERM, checkpointed, and
+            # exited clean (worker.py); 143 = the launcher itself was
+            # SIGTERMed. Either way the gang was preempted, not broken —
+            # the retry loop restarts it without burning budget.
+            from ..retry import Preempted
+
+            raise Preempted(f"distributed gang preempted (exit code {code})")
         if code != 0:
             raise ExecutionError(f"distributed gang exited with code {code}")
 

@@ -1,0 +1,52 @@
+"""SIGTERM-as-preemption-notice: cooperative checkpoint-and-exit.
+
+TPU spot slices get a grace window between the reclaim notice (SIGTERM to
+every container) and the hard kill. The handler here only flips an Event;
+the trainer's step loop observes it at the next step boundary, flushes a
+checkpoint, and raises `Preempted` — so the executor/worker can report a
+preemption (which never burns retry budget) instead of a generic failure.
+
+`install()` is idempotent and safe to call from worker processes and the
+in-process executor alike; on non-main threads (where Python forbids
+signal handlers) it degrades to a no-op — the flag can still be set
+programmatically via `trigger()` for tests.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+_flag = threading.Event()
+_installed = False
+
+
+def install() -> bool:
+    """Route SIGTERM to the preemption flag. Returns True when the handler
+    is in place (first call wins; later calls are no-ops returning True)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread — cannot own signal handlers
+        return False
+    _installed = True
+    return True
+
+
+def _handler(signum, frame):  # noqa: ARG001 — signal-handler signature
+    _flag.set()
+
+
+def trigger() -> None:
+    """Set the flag without a signal (tests, programmatic drain)."""
+    _flag.set()
+
+
+def requested() -> bool:
+    return _flag.is_set()
+
+
+def clear() -> None:
+    _flag.clear()

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import optax
 from flax import struct
 
+from ..chaos.injector import inject
 from ..data import build_data
 from ..models import build_model
 from ..ops.losses import accuracy as accuracy_metric
@@ -39,7 +40,9 @@ from ..parallel.sharding import (
     param_shardings,
     replicated,
 )
+from ..retry import Preempted
 from ..schemas.run_kinds import V1Program
+from . import preemption
 
 
 class TrainState(struct.PyTreeNode):
@@ -116,10 +119,12 @@ class Trainer:
         devices: Optional[list] = None,
         slices: int = 1,
         log_fn: Optional[Callable[[int, dict], None]] = None,
+        event_fn: Optional[Callable[[str, dict], None]] = None,
         checkpoint_dir: Optional[str] = None,
         artifacts_dir: Optional[str] = None,
     ):
         self.artifacts_dir = artifacts_dir
+        self.event_fn = event_fn
         self.program = program
         tspec = program.train
         if tspec is None:
@@ -550,6 +555,9 @@ class Trainer:
 
         t0 = time.perf_counter()
         for step in range(start_step, self.steps):
+            inject("trainer.step", step=step)
+            if preemption.requested():
+                self._preempt_exit(step, start_step)
             if prof_start is not None and step == prof_start and self.artifacts_dir:
                 jax.profiler.start_trace(str(Path(self.artifacts_dir) / "profile"))
                 profiling = True
@@ -625,6 +633,36 @@ class Trainer:
         history.append({"step": step, **vals})
         self.log_fn(step, vals)
 
+    def _event(self, kind: str, body: dict):
+        """Lifecycle events (preempted/resumed/checkpoint_fallback) to the
+        run store; advisory — an event sink fault never fails training."""
+        if self.event_fn is None:
+            return
+        try:
+            self.event_fn(kind, body)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _preempt_exit(self, step: int, start_step: int):
+        """SIGTERM landed: flush a checkpoint at the current boundary and
+        raise `Preempted` so the supervisor restarts us warm instead of
+        counting a failure. `step` steps are complete when the loop head
+        observes the flag, so the saved step IS the resume point."""
+        saved = None
+        if self.checkpoint_dir:
+            from .checkpoint import latest_step
+
+            saved = latest_step(self.checkpoint_dir, keep=self._ckpt_keep())
+            if step > start_step and (saved or 0) < step:
+                self.save(step, wait=True)
+                saved = step
+        self._event(
+            "preempted", {"step": step, "resume_step": int(saved or 0)}
+        )
+        raise Preempted(
+            f"SIGTERM preemption notice at step {step}", step=saved
+        )
+
     def close(self):
         """Release data-pipeline resources (native prefetch threads, corpus
         mmaps) deterministically. Long-lived agent processes run many
@@ -654,15 +692,19 @@ class Trainer:
         # keep flows through restore too: the per-directory manager cache
         # pins its options at FIRST touch, and resume touches it before the
         # first save — a keep-less call here would lock in the default
-        from .checkpoint import latest_step, restore_checkpoint
+        from .checkpoint import restore_latest_intact
 
-        keep = self._ckpt_keep()
-        step = latest_step(self.checkpoint_dir, keep=keep)
-        if step is None:
-            return 0
-        self.state = restore_checkpoint(
-            self.checkpoint_dir, step, self.state, keep=keep
+        state, step, corrupt = restore_latest_intact(
+            self.checkpoint_dir, self.state, keep=self._ckpt_keep()
         )
+        if corrupt:
+            self._event(
+                "checkpoint_fallback",
+                {"corrupt_steps": corrupt, "restored_step": step},
+            )
+        if step > 0:
+            self.state = state
+            self._event("resumed", {"step": step})
         return step
 
 

@@ -30,11 +30,19 @@ def main() -> int:
     # gang worker grabs the one real TPU chip and deadlocks in rendezvous.
     # The executor injects these for local gangs; the k8s converter leaves
     # them unset on real TPU pods.
-    from ..utils.jax_platform import apply_platform_env
+    from ..utils.jax_platform import apply_platform_env, enable_cpu_collectives
 
-    apply_platform_env()
+    platform = apply_platform_env()
+
+    # SIGTERM = preemption notice (spot reclaim, node drain): flag it so the
+    # training loop can checkpoint-and-exit instead of dying mid-write.
+    from . import preemption
+
+    preemption.install()
 
     if num_processes > 1:
+        if platform == "cpu":
+            enable_cpu_collectives()  # gloo: XLA:CPU has no native ones
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=num_processes,
@@ -50,6 +58,7 @@ def main() -> int:
     with open(spec_path) as f:
         payload = json.load(f)
 
+    from ..retry import Preempted
     from ..schemas.run_kinds import V1Program
     from .trainer import Trainer
 
@@ -72,16 +81,33 @@ def main() -> int:
             )
             store.append_log(run_uuid, line)
 
+    event_fn = None
+    if is_chief and store is not None:
+        def event_fn(kind: str, body: dict):
+            store.log_event(run_uuid, kind, body)
+
     trainer = Trainer(
         program,
         mesh_axes=payload.get("mesh"),
         slices=int(payload.get("slices") or 1),
         log_fn=log_fn,
+        event_fn=event_fn,
         # all processes participate in (multi-host) checkpointing
         checkpoint_dir=payload.get("checkpointDir"),
     )
     try:
         result = trainer.run()
+    except Preempted as e:
+        # clean preemption exit: checkpoint already flushed by the trainer.
+        # 75 (EX_TEMPFAIL) tells the launcher/executor "restart me warm" —
+        # distinguishable from a real crash, so no retry budget is burned.
+        if is_chief and store is not None:
+            store.log_event(
+                run_uuid,
+                "worker_preempted",
+                {"process_id": process_id, "step": e.step},
+            )
+        return 75
     finally:
         trainer.close()
     if is_chief and store is not None:

@@ -107,18 +107,28 @@ class Reconciler:
         store: RunStore,
         cluster: ClusterClient,
         queues: Optional[list[str]] = None,
+        error_budget: int = 3,
     ):
         """`queues` scopes ownership: when set, only runs routed through one
         of the named queues are reconciled. Two agents sharing a store (each
         serving its own queues) must not double-drive the same gang — a
         non-atomic read-bump of cluster_attempts plus double delete/submit
-        would burn the retry budget or tear down a fresh resubmit."""
+        would burn the retry budget or tear down a fresh resubmit.
+
+        `error_budget`: consecutive cluster-client failures tolerated per
+        run before its status is parked in UNKNOWN — "we cannot see this
+        gang" is a fact worth surfacing, distinct from "the gang failed".
+        A later successful poll resets the budget, and UNKNOWN recovers to
+        the observed phase through the normal `_advance` ladder."""
         self.store = store
         self.cluster = cluster
         self.queues = set(queues) if queues is not None else None
+        self.error_budget = max(1, int(error_budget))
         # last client-fault message logged per run: a persistent outage
         # must not append an identical line every tick
         self._last_err: dict[str, str] = {}
+        # consecutive client-fault count per run (the error budget meter)
+        self._errs: dict[str, int] = {}
 
     def _owns(self, uuid: str, status: dict) -> bool:
         """Ownership key: the ROUTED queue recorded in run meta at submit
@@ -177,6 +187,7 @@ class Reconciler:
             try:
                 change = self._tick_one(uuid)
                 self._last_err.pop(uuid, None)
+                self._errs.pop(uuid, None)  # a clean pass refills the budget
             except Exception as e:  # client fault: skip this run, not the tick
                 msg = f"reconcile error ({type(e).__name__}): {e}"
                 if self._last_err.get(uuid) != msg:  # log transitions only
@@ -185,10 +196,39 @@ class Reconciler:
                         self.store.append_log(uuid, msg)
                     except Exception:
                         pass  # even logging may hit the fault; keep draining
+                parked = self._burn_error_budget(uuid, msg)
+                if parked is not None:
+                    changes.append(parked)
                 continue
             if change is not None:
                 changes.append(change)
         return changes
+
+    def _burn_error_budget(self, uuid: str, msg: str) -> Optional[tuple[str, str]]:
+        """Count a consecutive client fault against the run's error budget;
+        once exhausted, park the run in UNKNOWN (we can no longer claim to
+        know its state). Legal only from SCHEDULED/STARTING/RUNNING — a
+        QUEUED run hasn't been handed to the cluster yet, so blindness to
+        the cluster says nothing about it."""
+        n = self._errs.get(uuid, 0) + 1
+        self._errs[uuid] = n
+        if n < self.error_budget:
+            return None
+        try:
+            current = V1Statuses(self.store.get_status(uuid)["status"])
+        except Exception:  # the store itself may be the faulting layer
+            return None
+        if current == V1Statuses.UNKNOWN or not can_transition(
+            current, V1Statuses.UNKNOWN
+        ):
+            return None
+        self.store.set_status(
+            uuid,
+            V1Statuses.UNKNOWN,
+            reason=f"error budget exhausted ({n} consecutive poll failures)",
+            message=msg,
+        )
+        return (uuid, V1Statuses.UNKNOWN)
 
     def _tick_one(self, uuid: str) -> Optional[tuple[str, str]]:
         manifest_path = self.store.run_dir(uuid) / "manifests.json"

@@ -1,8 +1,11 @@
-"""Termination policy: retries, TTL, timeout.
+"""Termination policy: retries, TTL, timeout, retry backoff.
 
 Reference parity: upstream `V1Termination` {maxRetries, ttl, timeout}
 (unverified, SURVEY.md §5 failure-detection row). The local scheduler and the
-C++ supervisor both honor max_retries; ttl drives cleanup.
+C++ supervisor both honor max_retries; ttl drives cleanup. The backoff
+fields shape the executor's retry spacing via `retry.RetryPolicy` —
+`backoff` defaults to 0 (immediate retry, the historical behavior), so
+specs that set only maxRetries keep their timing.
 """
 
 from __future__ import annotations
@@ -16,3 +19,7 @@ class V1Termination(BaseSchema):
     max_retries: Optional[int] = None
     ttl: Optional[int] = None  # seconds after finish before cleanup
     timeout: Optional[int] = None  # max runtime seconds
+    backoff: Optional[float] = None  # initial retry delay seconds (0 = now)
+    backoff_factor: Optional[float] = None  # exponential growth per attempt
+    backoff_max: Optional[float] = None  # delay ceiling seconds
+    jitter: Optional[float] = None  # max fractional delay shrink [0, 1)

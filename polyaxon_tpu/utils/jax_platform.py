@@ -50,8 +50,35 @@ def apply_platform(platform: str, n_cpu: int = 1) -> None:
     import jax
 
     if platform == "cpu":
-        jax.config.update("jax_num_cpu_devices", int(n_cpu))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(n_cpu))
+        except AttributeError:
+            # jax < 0.5 has no jax_num_cpu_devices; XLA_FLAGS works on every
+            # version as long as the backend hasn't initialized yet (true for
+            # the fresh worker interpreters this path serves)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={int(n_cpu)}"
+                ).strip()
     jax.config.update("jax_platforms", platform)
+
+
+def enable_cpu_collectives() -> bool:
+    """Route multi-process CPU collectives through gloo. The default
+    XLA:CPU client refuses cross-process collectives ("Multiprocess
+    computations aren't implemented on the CPU backend"); gloo ships in
+    jaxlib and makes local CPU gangs run real collectives — which is what
+    lets the distributed path be tested without a TPU slice. Must run
+    before the backend initializes. Returns False when this jaxlib has no
+    such knob (collectives will fail at first use instead)."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        return False
+    return True
 
 
 def apply_compilation_cache() -> Optional[str]:

@@ -7,12 +7,24 @@ vars, so platform selection must go through jax.config before backends
 initialize — conftest import time is early enough.
 """
 
-import jax
+import os
+
+# XLA reads XLA_FLAGS at backend init; setting it here (before any jax
+# import below triggers backend creation) works on every jax version,
+# including those without the jax_num_cpu_devices config option.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-
-import os  # noqa: E402
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # jax < 0.5 has no such option; XLA_FLAGS covers it
+    pass
 
 # children spawned by tests (multi-process distributed harness) inherit these
 os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,325 @@
+"""Deterministic fault-injection scenarios over the run lifecycle.
+
+Every scenario is seeded: the fault schedule (which step the kill lands
+on, which poll the preemption strikes) derives from the seed, and the
+test asserts the EXACT recovery point the plan's params predict — not
+just "it eventually succeeded"."""
+
+import time
+
+import jax
+import pytest
+import yaml
+
+from polyaxon_tpu import chaos
+from polyaxon_tpu.chaos import (
+    Fault,
+    FaultPlan,
+    FlakyCluster,
+    PartitionedCluster,
+    PreemptingCluster,
+    ScriptedCluster,
+)
+from polyaxon_tpu.compiler import compile_operation
+from polyaxon_tpu.connections.schemas import ConnectionCatalog
+from polyaxon_tpu.polyaxonfile import read_polyaxonfile
+from polyaxon_tpu.retry import PermanentError, RetryPolicy, TransientError, classify
+from polyaxon_tpu.runtime import Executor
+from polyaxon_tpu.scheduler.agent import Agent
+from polyaxon_tpu.scheduler.reconciler import ClusterSubmitter, Reconciler
+from polyaxon_tpu.schemas import V1Operation
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.store import RunStore
+
+pytestmark = pytest.mark.chaos
+
+
+def _train_op(name: str, *, steps: int, max_retries: int, checkpoint_every: int = 2):
+    return V1Operation.model_validate(
+        {
+            "kind": "operation",
+            "name": name,
+            "component": {
+                "kind": "component",
+                "termination": {"maxRetries": max_retries},
+                "run": {
+                    "kind": "jaxjob",
+                    "program": {
+                        "model": {
+                            "name": "mlp",
+                            "config": {"hidden": [16], "input_dim": 8, "num_classes": 4},
+                        },
+                        "data": {
+                            "name": "synthetic",
+                            "batchSize": 16,
+                            "config": {"shape": [8], "num_classes": 4},
+                        },
+                        "optimizer": {"name": "adamw", "learningRate": 0.01},
+                        "train": {
+                            "steps": steps,
+                            "logEvery": 2,
+                            "precision": "float32",
+                            "checkpointEvery": checkpoint_every,
+                        },
+                    },
+                },
+            },
+        }
+    )
+
+
+def _events(store, uuid, kind):
+    return [e for e in store.read_events(uuid) if e["kind"] == kind]
+
+
+def _conditions(store, uuid, type_=None):
+    conds = store.get_status(uuid)["conditions"]
+    return [c for c in conds if type_ is None or c["type"] == type_]
+
+
+# --------------------------------------------------------------- unit layer
+class TestRetryPolicy:
+    def test_delays_deterministic_and_capped(self):
+        p = RetryPolicy(max_retries=5, backoff=0.5, backoff_factor=2.0,
+                        backoff_max=2.0, jitter=0.0)
+        assert [p.delay(i) for i in range(4)] == [0.5, 1.0, 2.0, 2.0]
+        pj = RetryPolicy(max_retries=5, backoff=1.0, jitter=0.2)
+        d1 = pj.delay(0, seed="run-a")
+        assert d1 == pj.delay(0, seed="run-a")  # same seed → same jitter
+        assert 0.8 <= d1 <= 1.0  # jitter only shrinks
+
+    def test_classification(self):
+        from polyaxon_tpu.k8s.cluster import ClusterError, _is_transient_stderr
+
+        assert classify(TransientError("flap")) == "transient"
+        assert classify(PermanentError("bad spec")) == "permanent"
+        assert classify(ValueError("unknown")) == "transient"  # safe default
+        assert classify(ClusterError("x", transient=False)) == "permanent"
+        assert _is_transient_stderr(
+            "Unable to connect to the server: connection refused"
+        )
+        assert not _is_transient_stderr('error validating "STDIN": unknown field')
+
+    def test_permanent_error_not_retried_by_call(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise PermanentError("never works")
+
+        p = RetryPolicy(max_retries=3, backoff=0.0)
+        with pytest.raises(PermanentError):
+            p.call(fn)
+        assert len(calls) == 1  # zero retries burned on a permanent failure
+
+
+class TestFaultPlan:
+    def test_scenarios_reproducible_from_seed(self):
+        p1 = FaultPlan.corrupt_then_kill(42, steps=20, checkpoint_every=2)
+        p2 = FaultPlan.corrupt_then_kill(42, steps=20, checkpoint_every=2)
+        assert p1.params == p2.params
+        # the seed actually varies the scenario
+        kills = {
+            FaultPlan.kill_mid_run(s, steps=100).params["kill_step"]
+            for s in range(10)
+        }
+        assert len(kills) > 1
+
+    def test_fault_fires_once_then_spent(self):
+        plan = FaultPlan([Fault("p", "raise", at=1, count=1)])
+        with chaos.active(plan):
+            chaos.inject("p")  # hit 0: not due
+            with pytest.raises(chaos.ChaosError):
+                chaos.inject("p")  # hit 1: fires
+            chaos.inject("p")  # spent: the retry must not be re-killed
+        chaos.inject("p")  # disarmed: no-op
+
+
+# ---------------------------------------------------------- executor layer
+class TestChaosExecutor:
+    def test_kill_mid_run_resumes_at_checkpointed_step(self, tmp_home):
+        plan = FaultPlan.kill_mid_run(seed=3, steps=6, min_step=3)
+        kill_step = plan.params["kill_step"]
+        expected_resume = (kill_step // 2) * 2  # newest save before the kill
+        store = RunStore()
+        compiled = compile_operation(_train_op("chaos-kill", steps=6, max_retries=1))
+        with chaos.active(plan):
+            status = Executor(store, devices=jax.devices()[:1]).execute(compiled)
+        assert status == V1Statuses.SUCCEEDED
+        resumed = _events(store, compiled.run_uuid, "resumed")
+        assert resumed and resumed[0]["step"] == expected_resume
+        assert len(_conditions(store, compiled.run_uuid, "retrying")) == 1
+        assert store.read_metrics(compiled.run_uuid)[-1]["step"] == 6
+
+    def test_corrupt_latest_checkpoint_falls_back_to_intact(self, tmp_home):
+        plan = FaultPlan.corrupt_then_kill(seed=5, steps=8, checkpoint_every=2)
+        fallback = plan.params["fallback_step"]
+        corrupt_step = plan.params["corrupt_step"]
+        store = RunStore()
+        compiled = compile_operation(
+            _train_op("chaos-corrupt", steps=8, max_retries=1)
+        )
+        with chaos.active(plan):
+            status = Executor(store, devices=jax.devices()[:1]).execute(compiled)
+        assert status == V1Statuses.SUCCEEDED
+        fb = _events(store, compiled.run_uuid, "checkpoint_fallback")
+        assert fb, "corrupt checkpoint must be detected at restore"
+        assert fb[0]["restored_step"] == fallback
+        assert corrupt_step in fb[0]["corrupt_steps"]
+        resumed = _events(store, compiled.run_uuid, "resumed")
+        assert resumed and resumed[0]["step"] == fallback
+        assert store.read_metrics(compiled.run_uuid)[-1]["step"] == 8
+
+    def test_sigterm_preemption_checkpoints_and_restarts_free(self, tmp_home):
+        # maxRetries=0: ONLY the free preemption restart can finish this run
+        plan = FaultPlan.preempt_mid_run(seed=9, steps=6, min_step=2)
+        store = RunStore()
+        compiled = compile_operation(
+            _train_op("chaos-preempt", steps=6, max_retries=0)
+        )
+        with chaos.active(plan):
+            status = Executor(store, devices=jax.devices()[:1]).execute(compiled)
+        assert status == V1Statuses.SUCCEEDED
+        preempted = _events(store, compiled.run_uuid, "preempted")
+        assert preempted, "trainer must emit the preempted event"
+        retrying = _conditions(store, compiled.run_uuid, "retrying")
+        assert len(retrying) == 1 and retrying[0]["reason"] == "preempted"
+        assert store.read_metrics(compiled.run_uuid)[-1]["step"] == 6
+
+    def test_permanent_error_fails_fast_no_retries(self, tmp_home):
+        plan = FaultPlan(
+            [Fault("trainer.step", "raise_permanent", at=0,
+                   message="chaos: unfixable config")]
+        )
+        store = RunStore()
+        compiled = compile_operation(
+            _train_op("chaos-permanent", steps=6, max_retries=3)
+        )
+        with chaos.active(plan):
+            status = Executor(store, devices=jax.devices()[:1]).execute(compiled)
+        assert status == V1Statuses.FAILED
+        assert _conditions(store, compiled.run_uuid, "retrying") == []
+        last = _conditions(store, compiled.run_uuid)[-1]
+        assert last["reason"] == "PermanentError"
+
+    def test_backoff_spaced_retries_recorded(self, tmp_home):
+        op = V1Operation.model_validate(
+            {
+                "kind": "operation",
+                "name": "chaos-backoff",
+                "component": {
+                    "kind": "component",
+                    "termination": {
+                        "maxRetries": 2,
+                        "backoff": 0.05,
+                        "backoffFactor": 2,
+                        "jitter": 0,
+                    },
+                    "run": {"kind": "job", "container": {"command": ["false"]}},
+                },
+            }
+        )
+        store = RunStore()
+        compiled = compile_operation(op)
+        t0 = time.monotonic()
+        assert Executor(store).execute(compiled) == V1Statuses.FAILED
+        elapsed = time.monotonic() - t0
+        retries = _events(store, compiled.run_uuid, "retry")
+        assert [e["delay"] for e in retries] == [0.05, 0.1]
+        assert elapsed >= 0.15  # the sleeps actually happened
+        reasons = [c["reason"] for c in _conditions(store, compiled.run_uuid, "retrying")]
+        assert reasons == ["retry 1/2 after 0.05s", "retry 2/2 after 0.1s"]
+
+
+# --------------------------------------------------------- reconciler layer
+GANG_SPEC = {
+    "version": 1.1,
+    "kind": "operation",
+    "name": "chaosgang",
+    "component": {
+        "kind": "component",
+        "name": "chaosgang",
+        "termination": {"maxRetries": 0},
+        "run": {
+            "kind": "jaxjob",
+            "replicas": 2,
+            "container": {"image": "img", "command": ["train"]},
+        },
+    },
+}
+
+
+def _submit_gang(tmp_path, store, cluster):
+    p = tmp_path / "op.yaml"
+    p.write_text(yaml.safe_dump(GANG_SPEC))
+    op = read_polyaxonfile(str(p))
+    agent = Agent(
+        store=store,
+        submit_fn=ClusterSubmitter(store, cluster, ConnectionCatalog()),
+    )
+    uuid = agent.submit(op)
+    agent.drain()
+    return uuid
+
+
+def _drive(rec, store, uuid, ticks=30):
+    for _ in range(ticks):
+        rec.tick()
+        if store.get_status(uuid)["status"] == V1Statuses.SUCCEEDED:
+            break
+    return store.get_status(uuid)
+
+
+class TestChaosCluster:
+    def test_flaky_cluster_completes_within_error_budget(self, tmp_home, tmp_path):
+        inner = ScriptedCluster(pending_polls=1, running_polls=2)
+        store = RunStore()
+        uuid = _submit_gang(tmp_path, store, inner)
+        flaky = FlakyCluster(inner, seed=13, rate=0.5, max_consecutive=2)
+        rec = Reconciler(store, flaky, error_budget=3)
+        st = _drive(rec, store, uuid)
+        assert st["status"] == V1Statuses.SUCCEEDED
+        assert flaky.injected > 0, "the flake schedule must actually fire"
+        # flakes stayed inside the budget: never parked, no budget burned
+        types = [c["type"] for c in st["conditions"]]
+        assert "unknown" not in types
+        assert int((st.get("meta") or {}).get("cluster_attempts") or 0) == 0
+
+    def test_partition_parks_unknown_then_recovers(self, tmp_home, tmp_path):
+        inner = ScriptedCluster(pending_polls=1, running_polls=2)
+        store = RunStore()
+        # submit (global call 0) lands before the window; polls 1-3 black out
+        cluster = PartitionedCluster(inner, start=1, length=3)
+        uuid = _submit_gang(tmp_path, store, cluster)
+        rec = Reconciler(store, cluster, error_budget=3)
+        rec.tick()
+        rec.tick()
+        # two failed polls: budget not yet spent, status untouched
+        assert store.get_status(uuid)["status"] == V1Statuses.SCHEDULED
+        changes = rec.tick()  # third consecutive failure exhausts the budget
+        assert (uuid, V1Statuses.UNKNOWN) in changes
+        assert store.get_status(uuid)["status"] == V1Statuses.UNKNOWN
+        # partition heals: the run recovers through the normal ladder
+        st = _drive(rec, store, uuid, ticks=10)
+        assert st["status"] == V1Statuses.SUCCEEDED
+        types = [c["type"] for c in st["conditions"]]
+        assert "unknown" in types and types[-1] == "succeeded"
+
+    def test_gang_preemption_restarts_without_burning_budget(
+        self, tmp_home, tmp_path
+    ):
+        inner = ScriptedCluster(pending_polls=1, running_polls=2)
+        store = RunStore()
+        # seed=1 over window=3 draws poll index 2: the reconciler observes
+        # RUNNING (poll 1) before the reclaim lands, so the restart walks
+        # the full RUNNING→RETRYING→QUEUED ladder
+        cluster = PreemptingCluster(inner, seed=1, n_preemptions=1, window=3)
+        uuid = _submit_gang(tmp_path, store, cluster)
+        rec = Reconciler(store, cluster)
+        st = _drive(rec, store, uuid)
+        assert st["status"] == V1Statuses.SUCCEEDED
+        assert cluster.preempted == 1
+        # maxRetries is 0: only the budget-free preemption path can restart
+        assert int((st.get("meta") or {}).get("cluster_attempts") or 0) == 0
+        retrying = [c for c in st["conditions"] if c["type"] == "retrying"]
+        assert retrying and "preempted" in retrying[0]["reason"]
