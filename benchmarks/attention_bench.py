@@ -19,6 +19,7 @@ and ms/call; schema pinned by tests/test_benchmarks.py.
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 from functools import partial
@@ -30,7 +31,35 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from _timing import time_call as _time_call  # noqa: E402 — shared methodology
 
 
+def _already_captured(out_path: Path) -> set:
+    """(seq, kv_heads, backend, mode) rows already landed in --out —
+    a resumed sweep (tunnel died mid-run) skips them instead of
+    duplicating lines. Error rows don't count: they get retried."""
+    done = set()
+    if not out_path.exists():
+        return done
+    for line in out_path.read_text().splitlines():
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if "mode" in r and "error" not in r:
+            done.add((r["seq"], r["kv_heads"], r["backend"], r["mode"]))
+    return done
+
+
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("seqs", nargs="*", type=int, help="explicit seq lengths")
+    ap.add_argument(
+        "--out", default=None,
+        help="ALSO append each result line to this file as it is produced "
+             "— point it at the final committed .jsonl, not a temp file, "
+             "so a run killed mid-sweep (tunnel window closing) still "
+             "leaves every completed measurement on disk where the "
+             "evidence commit finds it; a re-run resumes past them",
+    )
+    args = ap.parse_args()
     # honor POLYAXON_JAX_PLATFORM=cpu BEFORE backend init — plain
     # JAX_PLATFORMS loses to the axon TPU plugin, and a dead tunnel
     # otherwise blocks ~25 min in native init
@@ -43,7 +72,23 @@ def main():
 
     from polyaxon_tpu.ops.attention import dot_product_attention
 
-    seqs = [int(a) for a in sys.argv[1:]] or [512, 1024, 2048, 4096, 8192]
+    sink = None
+    done = set()
+    if args.out:
+        out_path = Path(args.out)
+        done = _already_captured(out_path)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        # line-buffered append: each completed measurement hits the disk
+        # before the next one starts
+        sink = open(out_path, "a", buffering=1)
+
+    def emit(rec: dict):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if sink is not None:
+            sink.write(line + "\n")
+
+    seqs = args.seqs or [512, 1024, 2048, 4096, 8192]
     device = jax.devices()[0]
     batch, heads, head_dim = 4, 16, 128
     on_tpu = device.platform == "tpu"
@@ -88,36 +133,32 @@ def main():
 
                 bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
                 for mode, fn in (("fwd", fwd), ("fwd+bwd", bwd)):
+                    if (seq, kv_heads, backend, mode) in done:
+                        continue  # resumed sweep: already on disk
                     dt = _time_call(fn, q, k, v)
-                    print(
-                        json.dumps(
-                            {
-                                "seq": seq,
-                                "backend": backend,
-                                "mode": mode,
-                                "ms_per_call": round(dt * 1e3, 3),
-                                "tokens_per_sec": round(batch * seq / dt, 1),
-                                "platform": device.platform,
-                                "device_kind": device.device_kind,
-                                "batch": batch,
-                                "heads": heads,
-                                "kv_heads": kv_heads,
-                                "head_dim": head_dim,
-                            }
-                        ),
-                        flush=True,
-                    )
-            except Exception as e:  # noqa: BLE001 — report, keep sweeping
-                print(
-                    json.dumps(
+                    emit(
                         {
                             "seq": seq,
-                            "kv_heads": kv_heads,
                             "backend": backend,
-                            "error": f"{type(e).__name__}: {e}"[:200],
+                            "mode": mode,
+                            "ms_per_call": round(dt * 1e3, 3),
+                            "tokens_per_sec": round(batch * seq / dt, 1),
+                            "platform": device.platform,
+                            "device_kind": device.device_kind,
+                            "batch": batch,
+                            "heads": heads,
+                            "kv_heads": kv_heads,
+                            "head_dim": head_dim,
                         }
-                    ),
-                    flush=True,
+                    )
+            except Exception as e:  # noqa: BLE001 — report, keep sweeping
+                emit(
+                    {
+                        "seq": seq,
+                        "kv_heads": kv_heads,
+                        "backend": backend,
+                        "error": f"{type(e).__name__}: {e}"[:200],
+                    }
                 )
 
 

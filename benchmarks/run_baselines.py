@@ -75,6 +75,12 @@ def _configs(on_tpu: bool) -> dict:
             "llama_lora": dict(
                 model={"name": "llama", "config": {
                     "variant": "1b", "max_len": 1024,
+                    # single v5e chip: the [4, 1024, 128256] logits (f32
+                    # fwd + dlogits bwd) alone exceed HBM headroom next to
+                    # the 1.24B base — fused head+CE keeps them virtual,
+                    # flash attention streams KV instead of materializing
+                    # [B, H, S, S] scores (observed OOM at seq 1024, r5)
+                    "fused_lm_loss": True, "attention": "flash",
                     "lora": {"rank": 16, "alpha": 32,
                              "targets": ["q_proj", "k_proj", "v_proj", "o_proj"]}}},
                 data={"name": "synthetic_text", "batch_size": 4,

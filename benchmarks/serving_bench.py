@@ -1,0 +1,242 @@
+"""Serving fast-path benchmark: live HTTP server under concurrent clients.
+
+Measures the two layers ISSUE 2 added to `serving/` end to end, over the
+wire, against the same server code `polyaxon serve` runs:
+
+  * per_request mode (`ServingConfig(batching=False)`) — the legacy path:
+    one exact-shape jitted program per request signature, one device
+    dispatch per request. A randomized traffic mix recompiles constantly.
+  * batched mode — shape-bucketed compile cache (prompts LEFT-pad up a
+    geometric ladder; `prompt_lengths`/seeds are runtime [B] args) plus a
+    decode worker coalescing compatible requests up to `max_batch` /
+    `max_wait_ms`.
+
+Each mode drives its own server with N concurrent clients posting
+randomized (prompt_len, max_new, seed) requests, then reads GET /statsz.
+Prints one JSON line per mode plus a speedup line, in the same schema
+family as the other benches (tests/test_bench_script.py pins it):
+
+  {"metric": "serving_requests_per_sec", "value": ..., "unit": "req/s",
+   "mode": "batched", "clients": 16, "requests": 96, "p50_ms": ...,
+   "p95_ms": ..., "compile_count": 4, "batches": ...,
+   "mean_batch_occupancy": ..., "platform": ..., "device_kind": ...}
+  {"metric": "serving_batched_speedup", "value": 3.1, "unit": "x", ...}
+
+  python benchmarks/serving_bench.py                 # full: 16 clients
+  python benchmarks/serving_bench.py --smoke         # CI smoke: 4 clients
+  python benchmarks/serving_bench.py --mode batched  # one side only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+MODEL_CFG = {
+    "preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+    "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256,
+}
+
+
+def _post(url: str, body: dict, timeout: float = 300.0) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def make_traffic(n_requests: int, seed: int) -> list[dict]:
+    """Deterministic randomized request mix. Lengths are drawn from a
+    modest pool of distinct values — enough variety that the exact-shape
+    baseline keeps recompiling, small enough that the full run finishes
+    on CPU (every distinct (P, new) pair is ~one XLA compile there)."""
+    rng = random.Random(seed)
+    lengths = rng.sample(range(4, 49), 12)
+    news = [4, 6, 8]
+    out = []
+    for i in range(n_requests):
+        plen = rng.choice(lengths)
+        out.append(
+            {
+                "tokens": [
+                    [rng.randrange(MODEL_CFG["vocab_size"]) for _ in range(plen)]
+                ],
+                "maxNewTokens": rng.choice(news),
+                "temperature": 0.8,
+                "topK": 40,
+                "seed": i,
+            }
+        )
+    return out
+
+
+def build_server(batching: bool, max_batch: int, max_wait_ms: float):
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import build_model
+    from polyaxon_tpu.serving.batching import ServingConfig
+    from polyaxon_tpu.serving.server import ModelServer
+
+    bundle = build_model("transformer_lm", MODEL_CFG)
+    params = bundle.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )["params"]
+    return ModelServer(
+        bundle.module,
+        params,
+        model_name="serving-bench",
+        config=ServingConfig(
+            batching=batching, max_batch=max_batch, max_wait_ms=max_wait_ms
+        ),
+    )
+
+
+def drive(mode: str, traffic: list[dict], clients: int, max_batch: int,
+          max_wait_ms: float) -> dict:
+    """Run one server in `mode`, fire the traffic from `clients` threads,
+    return the stats record."""
+    server = build_server(mode == "batched", max_batch, max_wait_ms)
+    port = server.start(port=0)
+    url = f"http://127.0.0.1:{port}/generate"
+    # round-robin the SAME traffic across client threads so both modes see
+    # an identical request multiset regardless of thread scheduling
+    shards = [traffic[i::clients] for i in range(clients)]
+    latencies: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def client(shard: list[dict]):
+        for body in shard:
+            t0 = time.perf_counter()
+            try:
+                out = _post(url, body)
+                dt = time.perf_counter() - t0
+                row = out["tokens"][0]
+                want = len(body["tokens"][0]) + body["maxNewTokens"]
+                if len(row) != want:
+                    raise AssertionError(
+                        f"row length {len(row)} != prompt+new {want}"
+                    )
+                with lock:
+                    latencies.append(dt)
+            except Exception as e:  # noqa: BLE001 — count, keep driving
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    threads = [
+        threading.Thread(target=client, args=(s,), daemon=True)
+        for s in shards if s
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stats = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statsz", timeout=30
+        ).read()
+    )
+    server.stop()
+
+    import jax
+
+    device = jax.devices()[0]
+    lat_ms = sorted(l * 1e3 for l in latencies)
+    rec = {
+        "metric": "serving_requests_per_sec",
+        "value": round(len(latencies) / wall, 2) if wall > 0 else 0.0,
+        "unit": "req/s",
+        "mode": mode,
+        "clients": clients,
+        "requests": len(latencies),
+        "wall_s": round(wall, 2),
+        "p50_ms": round(statistics.median(lat_ms), 1) if lat_ms else None,
+        "p95_ms": (
+            round(lat_ms[int(0.95 * (len(lat_ms) - 1))], 1) if lat_ms else None
+        ),
+        "compile_count": stats["compile_count"],
+        "batches": stats["batches"],
+        "mean_batch_occupancy": stats["mean_batch_occupancy"],
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+    if errors:
+        rec["errors"] = len(errors)
+        rec["first_error"] = errors[0]
+    return rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=96,
+                    help="total requests per mode")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=20.0)
+    ap.add_argument("--mode", choices=("both", "batched", "per_request"),
+                    default="both")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI configuration (4 clients, 12 requests)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.clients, args.requests = 4, 12
+
+    # honor POLYAXON_JAX_PLATFORM=cpu BEFORE backend init (see
+    # attention_bench.py — plain JAX_PLATFORMS loses to the TPU plugin)
+    from polyaxon_tpu.utils.jax_platform import apply_platform_env
+
+    apply_platform_env()
+
+    traffic = make_traffic(args.requests, args.seed)
+    modes = (
+        ("per_request", "batched") if args.mode == "both" else (args.mode,)
+    )
+    recs = {}
+    for mode in modes:
+        recs[mode] = drive(
+            mode, traffic, args.clients, args.max_batch, args.max_wait_ms
+        )
+        print(json.dumps(recs[mode]), flush=True)
+    if len(recs) == 2 and recs["per_request"]["value"] > 0:
+        print(
+            json.dumps(
+                {
+                    "metric": "serving_batched_speedup",
+                    "value": round(
+                        recs["batched"]["value"] / recs["per_request"]["value"],
+                        2,
+                    ),
+                    "unit": "x",
+                    "clients": args.clients,
+                    "requests": args.requests,
+                    "compiles_batched": recs["batched"]["compile_count"],
+                    "compiles_per_request": recs["per_request"]["compile_count"],
+                    "platform": recs["batched"]["platform"],
+                }
+            ),
+            flush=True,
+        )
+    failed = [m for m, r in recs.items() if r.get("errors")]
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

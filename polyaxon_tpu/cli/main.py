@@ -489,10 +489,23 @@ def agent_drain(queues):
 @click.option("--mesh", default=None,
               help="shard params over a device mesh, e.g. model=4 or "
                    "model=2,fsdp=2 — for models too big for one chip")
-def serve(uid, host, port, mesh):
+@click.option("--max-batch", default=None, type=int,
+              help="coalesce up to N compatible requests into one decode "
+                   "(continuous batching; default 8)")
+@click.option("--max-wait-ms", default=None, type=float,
+              help="how long a partial batch waits for stragglers "
+                   "(default 5.0)")
+@click.option("--buckets", default=None,
+              help="prompt-length bucket ladder, e.g. 32,64,128,256 "
+                   "(default: geometric ladder up to the model's seq_len)")
+@click.option("--no-batching", is_flag=True,
+              help="disable bucketing+coalescing: one exact-shape compile "
+                   "per request signature (debug/baseline mode)")
+def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching):
     """Serve a checkpointed LM run's generation over HTTP
-    (GET /healthz, POST /generate)."""
+    (GET /healthz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
+    from ..serving.batching import ServingConfig
     from ..serving.server import ServingError
 
     mesh_axes = None
@@ -506,15 +519,43 @@ def serve(uid, host, port, mesh):
             raise click.ClickException(
                 f"--mesh expects axis=N[,axis=N...], got {mesh!r}"
             )
+    # only build an override config when a flag was given — otherwise the
+    # run spec's own `serving:` section (if any) supplies the defaults
+    config = None
+    if any(v is not None for v in (max_batch, max_wait_ms, buckets)) or no_batching:
+        try:
+            ladder = (
+                tuple(int(b) for b in buckets.split(",")) if buckets else None
+            )
+        except ValueError:
+            raise click.ClickException(
+                f"--buckets expects N,N,... ints, got {buckets!r}"
+            )
+        defaults = ServingConfig()
+        config = ServingConfig(
+            max_batch=max_batch if max_batch is not None else defaults.max_batch,
+            max_wait_ms=(
+                max_wait_ms if max_wait_ms is not None else defaults.max_wait_ms
+            ),
+            prompt_buckets=ladder,
+            batching=not no_batching,
+        )
     try:
-        server = ModelServer.from_run(uid, mesh_axes=mesh_axes)
+        server = ModelServer.from_run(uid, mesh_axes=mesh_axes, config=config)
     except (ServingError, KeyError, ValueError) as e:
         # ValueError: mesh-vs-device/model mismatch from the mesh builder
         raise click.ClickException(str(e.args[0]) if e.args else str(e))
     bound = server.start(host=host, port=port)
+    mode = (
+        f"batching max_batch={server.config.max_batch} "
+        f"max_wait_ms={server.config.max_wait_ms}"
+        if server.config.batching
+        else "per-request (no batching)"
+    )
     click.echo(
         f"serving {server.model_name} (step {server.step}) "
-        f"on http://{host}:{bound} — POST /generate, GET /healthz"
+        f"on http://{host}:{bound} [{mode}] — "
+        "POST /generate, GET /healthz, GET /statsz"
     )
     import signal
     import threading

@@ -27,15 +27,31 @@ import jax
 import jax.numpy as jnp
 
 
+def _top_k_mask(logits, top_k: Optional[int]):
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -1e30)
+    return logits
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     """logits: [B, V] → [B] sampled token ids. temperature 0 = greedy."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits >= kth, logits, -1e30)
+    logits = _top_k_mask(logits / temperature, top_k)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _sample_rows(logits, rngs, temperature: float, top_k: Optional[int]):
+    """Per-row keys: logits [B, V], rngs [B]-batched PRNG keys → [B] ids.
+
+    The serving coalescer batches INDEPENDENT requests into one decode, so
+    each row samples from its own request's key stream — coalescing must
+    not correlate (or recompile over) client seeds."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _top_k_mask(logits / temperature, top_k)
+    return jax.vmap(jax.random.categorical)(rngs, logits).astype(jnp.int32)
 
 
 def generate(
@@ -48,7 +64,9 @@ def generate(
     top_k: Optional[int] = None,
     eos_id: Optional[int] = None,
     seed=0,  # int, or a traced int32 scalar (jit-friendly: shape-static fns
-    # can take the seed as a runtime argument instead of recompiling per seed)
+    # can take the seed as a runtime argument instead of recompiling per seed),
+    # or a [B] array of per-row seeds (one independent stream per batch row)
+    prompt_lengths=None,  # [B] true lengths of a LEFT-padded prompt batch
 ) -> jnp.ndarray:
     """Generate `max_new_tokens` continuations of `prompt` [B, P] (int32).
 
@@ -57,6 +75,16 @@ def generate(
     at position P. With `eos_id`, rows that emit it are padded with eos
     from then on. Total length is capped by the model's cfg.seq_len (the
     cache size).
+
+    Shape bucketing (the serving fast path): with `prompt_lengths` [B],
+    `prompt` is LEFT-padded to the shared width P and row b's true tokens
+    occupy `prompt[b, P - prompt_lengths[b]:]`. Pad slots are masked out of
+    attention and rotary positions are offset per row, so every true length
+    in [1, P] shares ONE compiled program and row b's useful output is
+    `out[b, P - prompt_lengths[b]:]` — identical to an unbucketed run of
+    that row alone. With per-row seeds the sample stream is keyed by
+    GENERATION index (not absolute position), so a row's tokens are also
+    invariant to which bucket or batch it was coalesced into.
     """
     cfg = module.cfg
     B, P = prompt.shape
@@ -67,6 +95,11 @@ def generate(
             f"exceeds the model's seq_len {cfg.seq_len} (the KV cache size)"
         )
     prompt = prompt.astype(jnp.int32)
+    pad = None
+    pad_kw = {}
+    if prompt_lengths is not None:
+        pad = (P - jnp.asarray(prompt_lengths, jnp.int32)).astype(jnp.int32)
+        pad_kw = {"pad": pad}  # only modules on the bucketed path take it
 
     # cache creation pass: one dummy mutable apply materializes zeroed
     # cache variables (flax recipe — variables appear on first mutable use)
@@ -82,21 +115,31 @@ def generate(
     cache0 = init_vars["cache"]
 
     # batched prefill: the whole prompt in ONE forward that fills the
-    # cache; its last-position logits sample the first new token
+    # cache; its last-position logits sample the first new token (with
+    # left-padding, position -1 is every row's last TRUE token)
     logits, vars1 = module.apply(
         {"params": params, "cache": cache0},
         prompt,
         train=False,
         decode=True,
         mutable=["cache"],
+        **pad_kw,
     )
-    rng0 = jax.random.PRNGKey(seed)
-    first = _sample(
-        logits[:, -1].astype(jnp.float32),
-        jax.random.fold_in(rng0, 0),
-        temperature,
-        top_k,
-    )
+    per_row_seed = getattr(jnp.asarray(seed), "ndim", 0) == 1
+    if per_row_seed:
+        row_keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.asarray(seed, jnp.int32)
+        )
+
+        def step_rng(g):  # g = generation index, uniform across rows
+            return jax.vmap(lambda k: jax.random.fold_in(k, g))(row_keys)
+
+        sample = lambda lg, g: _sample_rows(lg, step_rng(g), temperature, top_k)  # noqa: E731
+    else:
+        rng0 = jax.random.PRNGKey(seed)
+        # keyed by absolute buf position, as always (pinned by tests)
+        sample = lambda lg, t: _sample(lg, jax.random.fold_in(rng0, t), temperature, top_k)  # noqa: E731
+    first = sample(logits[:, -1].astype(jnp.float32), 0)
 
     buf = jnp.zeros((B, total), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
@@ -111,12 +154,13 @@ def generate(
             train=False,
             decode=True,
             mutable=["cache"],
+            **pad_kw,
         )
-        nxt = _sample(
+        # per-row streams key on generation index (t - P + 1): invariant to
+        # the bucket's pad; the scalar stream keys on absolute position t
+        nxt = sample(
             logits[:, -1].astype(jnp.float32),
-            jax.random.fold_in(rng0, t),
-            temperature,
-            top_k,
+            (t - P + 1) if per_row_seed else t,
         )
         if eos_id is not None:
             # latch only on GENERATED eos: the fed token at position >= P

@@ -98,6 +98,18 @@ def apply_rope(x: jnp.ndarray, cos, sin, offset: int = 0):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
 
+def apply_rope_at(x: jnp.ndarray, cos, sin, positions: jnp.ndarray):
+    """x: [B, S, H, D]; positions: [B, S] per-row absolute rotary positions.
+
+    The left-padded decode path: rows of one batch sit at DIFFERENT true
+    positions for the same cache slot (slot - row_pad), so the table lookup
+    is a gather instead of apply_rope's shared slice."""
+    c = jnp.take(cos, positions, axis=0)[:, :, None, :]  # [B, S, 1, half]
+    s = jnp.take(sin, positions, axis=0)[:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
 
@@ -141,7 +153,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False, decode: bool = False):
+    def __call__(self, x, *, train: bool = False, decode: bool = False, pad=None):
         cfg = self.cfg
         B, S, _ = x.shape
         hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -180,8 +192,19 @@ class Attention(nn.Module):
                 # generation costs 1 forward + (new-1) cached steps instead
                 # of (P + new - 1) sequential steps
                 pos = cache_index.value
-                q = apply_rope(q, cos, sin, offset=pos)
-                k = apply_rope(k, cos, sin, offset=pos)
+                if pad is None:
+                    q = apply_rope(q, cos, sin, offset=pos)
+                    k = apply_rope(k, cos, sin, offset=pos)
+                else:
+                    # left-padded rows: cache slot s holds the row's true
+                    # position s - pad[b]. Pad slots clamp to 0 — their K/V
+                    # never attend (masked below), only the table index
+                    # must stay in range.
+                    positions = jnp.maximum(
+                        pos + jnp.arange(S)[None, :] - pad[:, None], 0
+                    )
+                    q = apply_rope_at(q, cos, sin, positions)
+                    k = apply_rope_at(k, cos, sin, positions)
                 k_all = jax.lax.dynamic_update_slice(
                     cached_k.value, k, (0, pos, 0, 0)
                 )
@@ -207,7 +230,12 @@ class Attention(nn.Module):
                     jnp.arange(cfg.seq_len)[None, :]
                     <= (pos + jnp.arange(S))[:, None]
                 )
-                scores = jnp.where(live[None, None, :, :], scores, -1e30)
+                mask = live[None, None, :, :]
+                if pad is not None:
+                    # left-pad slots are dead for every query of that row
+                    valid = jnp.arange(cfg.seq_len)[None, :] >= pad[:, None]
+                    mask = mask & valid[:, None, None, :]
+                scores = jnp.where(mask, scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
                 out = jnp.einsum(
                     "bkgqs,bskd->bqkgd",
@@ -261,7 +289,7 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pad=None):
         from ..parallel.sharding import constrain
 
         cfg = self.cfg
@@ -270,6 +298,7 @@ class Block(nn.Module):
             RMSNorm(cfg.norm_eps, name="attention_norm")(x),
             train=self.train,
             decode=self.decode,
+            pad=pad,
         )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
@@ -294,15 +323,23 @@ class Block(nn.Module):
 
 
 class _ScanBlock(nn.Module):
-    """Scan body: (carry, _) → (carry, None) signature nn.scan requires."""
+    """Scan body: (carry, _) → (carry, None) signature nn.scan requires.
+
+    The carry is either the activations alone or, on the left-padded decode
+    path, an (activations, pad) tuple — pad rides in the carry (unchanged by
+    every layer) because a traced array cannot be a module attribute."""
 
     cfg: TransformerConfig
     train: bool = False
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, _):
-        return Block(self.cfg, self.train, self.decode, name="block")(x), None
+    def __call__(self, carry, _):
+        block = Block(self.cfg, self.train, self.decode, name="block")
+        if isinstance(carry, tuple):
+            x, pad = carry
+            return (block(x, pad=pad), pad), None
+        return block(carry), None
 
 
 class PipelinedLayers(nn.Module):
@@ -375,6 +412,7 @@ class Transformer(nn.Module):
         train: bool = False,
         decode: bool = False,
         return_features: bool = False,
+        pad=None,  # [B] left-pad widths for bucketed decode (serving path)
     ):
         cfg = self.cfg
         if decode and cfg.pipeline_stages > 1:
@@ -382,6 +420,11 @@ class Transformer(nn.Module):
                 "KV-cache decode is not supported with pipeline_stages > 1 "
                 "(the stage-stacked weights have no per-layer cache slots); "
                 "generate with a non-pipelined copy of the params"
+            )
+        if pad is not None and not decode:
+            raise ValueError(
+                "pad (left-pad widths) only applies to the KV-cache decode "
+                "path; training/eval should mask via labels instead"
             )
         embed = nn.Embed(
             cfg.vocab_size,
@@ -409,10 +452,15 @@ class Transformer(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
             )
-            x, _ = Layers(cfg, train, decode, name="layers")(x, None)
+            if pad is not None:
+                (x, _), _ = Layers(cfg, train, decode, name="layers")(
+                    (x, pad), None
+                )
+            else:
+                x, _ = Layers(cfg, train, decode, name="layers")(x, None)
         else:
             for i in range(cfg.n_layers):
-                x = Block(cfg, train, decode, name=f"layer_{i}")(x)
+                x = Block(cfg, train, decode, name=f"layer_{i}")(x, pad=pad)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_features:
             # fused-loss path: the caller computes head+loss from features;

@@ -118,6 +118,54 @@ class V1TrainSpec(BaseSchema):
         return self
 
 
+class V1ServingSpec(BaseSchema):
+    """Serving fast-path knobs (serving/batching.py) a run can pin in its
+    spec, so `polyaxon serve --uid <run>` comes up with the shape the model
+    was validated at. CLI flags and an explicit ServingConfig override."""
+
+    # continuous batching: coalesce up to maxBatch compatible requests,
+    # waiting at most maxWaitMs for stragglers; batching=false restores the
+    # legacy one-exact-shape-program-per-request path
+    max_batch: int | str = 8
+    max_wait_ms: float | str = 5.0
+    batching: bool = True
+    # shape-bucket ladders (ascending); None = geometric auto-ladder up to
+    # the model's seq_len
+    prompt_buckets: Optional[list[int]] = None
+    max_new_buckets: Optional[list[int]] = None
+    request_timeout_s: float | str = 600.0
+
+    @model_validator(mode="after")
+    def _check(self):
+        if isinstance(self.max_batch, int) and self.max_batch < 1:
+            raise ValueError(f"maxBatch must be >= 1, got {self.max_batch}")
+        for name in ("prompt_buckets", "max_new_buckets"):
+            ladder = getattr(self, name)
+            if ladder is not None and (
+                not ladder or any(b < 1 for b in ladder)
+            ):
+                raise ValueError(
+                    f"{name} must be a non-empty list of positive ints"
+                )
+        return self
+
+    def to_config(self):
+        from ..serving.batching import ServingConfig
+
+        return ServingConfig(
+            max_batch=int(self.max_batch),
+            max_wait_ms=float(self.max_wait_ms),
+            batching=self.batching,
+            prompt_buckets=(
+                tuple(self.prompt_buckets) if self.prompt_buckets else None
+            ),
+            max_new_buckets=(
+                tuple(self.max_new_buckets) if self.max_new_buckets else None
+            ),
+            request_timeout_s=float(self.request_timeout_s),
+        )
+
+
 class V1Program(BaseSchema):
     """Native training program executed in-process by the JAXJob runtime
     (runtime/trainer.py) — this replaces the reference's user-container +
@@ -127,6 +175,7 @@ class V1Program(BaseSchema):
     data: Optional[V1DataSpec] = None
     optimizer: Optional[V1OptimizerSpec] = None
     train: Optional[V1TrainSpec] = None
+    serving: Optional[V1ServingSpec] = None
 
 
 class V1MeshSpec(BaseSchema):

@@ -1,1 +1,2 @@
+from .batching import ServingConfig  # noqa: F401
 from .server import ModelServer  # noqa: F401

@@ -1,0 +1,261 @@
+"""Shape bucketing + cross-request coalescing for the serving fast path.
+
+Two cooperating layers (ISSUE 2):
+
+**Bucketing** — a realistic traffic mix has one distinct `(prompt_len,
+max_new)` per request; jitting one decode program per exact shape means
+20-40 s of XLA compile per novel request and an LRU that thrashes under
+varied lengths. Instead prompts are LEFT-padded up to a small geometric
+ladder of widths (models/generate.py masks the pad out of attention and
+offsets rotary positions per row), so the compile count is O(#buckets),
+not O(#distinct shapes).
+
+**Coalescing** — a single-request decode leaves the accelerator idle
+between dispatches. `DecodeCoalescer` runs ONE worker thread fed by a
+queue: the HTTP handlers are producers only, and compatible requests
+(same bucket + sampling signature; seed is a per-row runtime argument)
+merge into one batched decode of up to `max_batch` rows, waiting at most
+`max_wait_ms` for stragglers. Responses scatter back to the waiting
+handler threads through per-request events. Single-threaded jax
+tracing/execution holds by construction.
+
+This module is deliberately free of jax: the ladder math and the worker
+loop are unit-testable with a fake executor (tests/test_serving_batch.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+
+def bucket_ladder(lo: int, hi: int, factor: int = 2) -> tuple[int, ...]:
+    """Geometric ladder lo, lo*factor, ... capped at (and including) hi."""
+    if hi < 1:
+        raise ValueError(f"ladder upper bound must be >= 1, got {hi}")
+    lo = max(1, min(lo, hi))
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= factor
+    out.append(hi)
+    return tuple(out)
+
+
+def bucket_for(n: int, ladder: tuple[int, ...]) -> Optional[int]:
+    """Smallest bucket >= n, or None when n exceeds the ladder."""
+    for b in ladder:
+        if b >= n:
+            return b
+    return None
+
+
+def choose_buckets(
+    prompt_len: int,
+    max_new: int,
+    prompt_ladder: tuple[int, ...],
+    new_ladder: tuple[int, ...],
+    seq_len: int,
+) -> tuple[int, int]:
+    """(prompt_bucket, new_bucket) for one request, guaranteeing
+    prompt_bucket + new_bucket <= seq_len (the KV-cache size).
+
+    Rounding both up can overflow the cache even when the raw request
+    fits (seq 64, len 40 → bucket 64, new 16 → 80): prefer the largest
+    ladder pair that fits, and degrade to the EXACT request shape as the
+    escape hatch — correctness first, compile-sharing when possible."""
+    nb = bucket_for(max_new, new_ladder) or max_new
+    pb = None
+    for b in prompt_ladder:
+        if b >= prompt_len and b + nb <= seq_len:
+            pb = b
+            break
+    if pb is None:
+        pb = prompt_len
+        if pb + nb > seq_len:
+            nb = max_new
+    return pb, nb
+
+
+def batch_bucket(n: int, max_batch: int) -> int:
+    """Round a partial batch up to the next power of two <= max_batch, so
+    compiled batch shapes also form a small ladder (padded rows are dummy
+    length-1 prompts whose outputs are dropped)."""
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for the serving fast path (schemas.run_kinds.V1ServingSpec
+    carries the same fields in the stored spec; CLI flags override)."""
+
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    prompt_buckets: Optional[tuple[int, ...]] = None  # None = auto ladder
+    max_new_buckets: Optional[tuple[int, ...]] = None
+    batching: bool = True
+    request_timeout_s: float = 600.0
+
+    def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
+        pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
+        nl = self.max_new_buckets or bucket_ladder(min(16, seq_len), seq_len)
+        return tuple(sorted(pl)), tuple(sorted(nl))
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupKey:
+    """Requests coalesce iff their keys are equal: one compiled program and
+    one batched dispatch per group. Seed is deliberately absent — it is a
+    [B] runtime argument, not part of the signature."""
+
+    prompt_bucket: int
+    new_bucket: int
+    temperature: float
+    top_k: Optional[int]
+    eos_id: Optional[int]
+    num_beams: int = 1
+    length_penalty: float = 1.0
+
+
+@dataclasses.dataclass
+class PendingRequest:
+    tokens: list  # [prompt_len] int token ids (single row)
+    prompt_len: int
+    max_new: int  # what the client asked for (<= key.new_bucket)
+    seed: int
+    key: GroupKey
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[list] = None  # row token ids on success
+    error: Optional[BaseException] = None
+
+    def finish(self, result=None, error=None):
+        self.result = result
+        self.error = error
+        self.done.set()
+
+
+class DecodeCoalescer:
+    """Single consumer thread over a request queue.
+
+    The worker drains the queue into a pending deque, takes the OLDEST
+    request's key, and gathers every same-key request (arrival order kept)
+    up to `max_batch`. A full batch flushes immediately; a partial one
+    waits until the oldest member is `max_wait_ms` old, so an isolated
+    request pays at most the wait and a burst pays (almost) nothing.
+    Requests with other keys stay pending — never reordered relative to
+    their own group, never starved (oldest-first head selection)."""
+
+    _SHUTDOWN = object()
+
+    def __init__(
+        self,
+        execute: Callable[[list[PendingRequest]], None],
+        *,
+        max_batch: int = 8,
+        max_wait_ms: float = 5.0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._execute = execute
+        self.max_batch = int(max_batch)
+        self.max_wait = max(0.0, float(max_wait_ms)) / 1000.0
+        self._queue: queue.Queue = queue.Queue()
+        self._pending: deque[PendingRequest] = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # occupancy telemetry (read by /statsz and serving_bench)
+        self.batches_run = 0
+        self.rows_run = 0
+
+    # ------------------------------------------------------------ producer
+    def submit(self, req: PendingRequest):
+        if self._stop.is_set():
+            raise RuntimeError("coalescer is stopped")
+        self._queue.put(req)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="decode-coalescer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        self._queue.put(self._SHUTDOWN)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # fail fast for anything still parked — the server is going away
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not self._SHUTDOWN:
+                self._pending.append(item)
+        for req in list(self._pending):
+            req.finish(error=RuntimeError("server shutting down"))
+        self._pending.clear()
+
+    # ------------------------------------------------------------ consumer
+    def _drain_into_pending(self, timeout: Optional[float]) -> bool:
+        """Move queued requests into pending; block up to `timeout` for the
+        first one. Returns False on shutdown."""
+        try:
+            item = self._queue.get(timeout=timeout) if timeout else self._queue.get_nowait()
+        except queue.Empty:
+            return True
+        if item is self._SHUTDOWN:
+            return False
+        self._pending.append(item)
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return True
+            if item is self._SHUTDOWN:
+                return False
+            self._pending.append(item)
+
+    def _loop(self):
+        alive = True
+        while alive or self._pending:
+            if not self._pending:
+                alive = self._drain_into_pending(timeout=0.1)
+                continue
+            head = self._pending[0]
+            batch = [r for r in self._pending if r.key == head.key][
+                : self.max_batch
+            ]
+            deadline = head.enqueued_at + self.max_wait
+            now = time.monotonic()
+            if len(batch) < self.max_batch and now < deadline and alive:
+                # wait (bounded by the head's age) for coalescable arrivals
+                alive = self._drain_into_pending(timeout=deadline - now)
+                continue
+            for r in batch:
+                self._pending.remove(r)
+            self.batches_run += 1
+            self.rows_run += len(batch)
+            try:
+                self._execute(batch)
+            except BaseException as e:  # noqa: BLE001 — scatter, don't die
+                for r in batch:
+                    if not r.done.is_set():
+                        r.finish(error=e)
+            # opportunistically pick up anything that arrived mid-execute
+            if alive:
+                alive = self._drain_into_pending(timeout=None)
+        self._stop.set()

@@ -10,15 +10,30 @@ command:
 
 Endpoints:
   GET  /healthz           → {"status": "ok", "model": ..., "step": N}
+  GET  /statsz            → {"compile_count": N, "requests": N,
+                             "batches": N, "mean_batch_occupancy": x, ...}
   POST /generate          → {"tokens": [[...]]}
      body: {"tokens": [[int]], "maxNewTokens": int, "temperature": float,
             "topK": int?, "eosId": int?, "seed": int?,
             "numBeams": int? (beam search when > 1), "lengthPenalty": float?}
 
-Design: the server owns ONE jitted decode program per (batch, prompt_len,
-max_new) shape triple (generate() is a single static-length lax.scan);
-repeated calls with the same shape reuse the compiled program. Serving is
-read-only — params are restored once at startup.
+Design — the serving fast path (serving/batching.py):
+
+  * Shape bucketing: prompts are LEFT-padded up to a geometric ladder of
+    widths and `maxNewTokens` rounds up the same way, so rows of different
+    true lengths share ONE compiled decode program (generate() masks pad
+    out of attention and offsets rotary positions per row). Compile count
+    is O(#buckets), not O(#distinct request shapes).
+  * Continuous batching: HTTP handler threads are producers only; a single
+    decode worker coalesces same-signature requests (per-row seed is a [B]
+    runtime argument) into one batched dispatch of up to `max_batch` rows,
+    waiting at most `max_wait_ms`, and scatters rows back to the waiting
+    handlers. jax tracing/execution is single-threaded by construction.
+
+`ServingConfig(batching=False)` restores the legacy per-request path (one
+exact-shape jitted program per signature, LRU of 32) — beam-search
+requests always use it. Serving is read-only — params are restored once
+at startup.
 """
 
 from __future__ import annotations
@@ -29,6 +44,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..store.local import RunStore
+from .batching import (
+    DecodeCoalescer,
+    GroupKey,
+    PendingRequest,
+    ServingConfig,
+    batch_bucket,
+    choose_buckets,
+)
 
 
 def _restore_params_subtree(ckpt_dir: str, abstract_params):
@@ -47,21 +70,30 @@ def _restore_params_subtree(ckpt_dir: str, abstract_params):
         step = mgr.latest_step()
         if step is None:
             raise ServingError(f"no restorable checkpoint in {ckpt_dir}")
-        out = mgr.restore(
-            step,
-            args=ocp.args.PyTreeRestore(
+        # explicit restore args: arrays land on THIS topology's shardings
+        # (serving mesh), not the sharding recorded at save time —
+        # train-on-8-hosts/serve-on-1 must work
+        restore_args = {
+            "params": ocp.checkpoint_utils.construct_restore_args(
+                abstract_params
+            )
+        }
+        try:
+            args = ocp.args.PyTreeRestore(
                 {"params": abstract_params},
-                # explicit restore args: arrays land on THIS topology's
-                # shardings (serving mesh), not the sharding recorded at
-                # save time — train-on-8-hosts/serve-on-1 must work
-                restore_args={
-                    "params": ocp.checkpoint_utils.construct_restore_args(
-                        abstract_params
-                    )
-                },
+                restore_args=restore_args,
                 partial_restore=True,
-            ),
-        )
+            )
+        except TypeError:
+            # orbax < 0.11 has no partial_restore kwarg; the same "restore
+            # only the keys present in item, drop the rest of the saved
+            # tree" semantics are spelled as empty transforms there
+            args = ocp.args.PyTreeRestore(
+                {"params": abstract_params},
+                restore_args=restore_args,
+                transforms={},
+            )
+        out = mgr.restore(step, args=args)
         return out["params"], step
     finally:
         mgr.close()
@@ -72,51 +104,88 @@ class ServingError(RuntimeError):
 
 
 class ModelServer:
-    def __init__(self, module, params, *, model_name: str = "?", step: int = 0):
+    def __init__(
+        self,
+        module,
+        params,
+        *,
+        model_name: str = "?",
+        step: int = 0,
+        config: Optional[ServingConfig] = None,
+    ):
         self.module = module
         self.params = params
         self.model_name = model_name
         self.step = step
+        self.config = config or ServingConfig()
+        self._prompt_ladder, self._new_ladder = self.config.ladders(
+            int(module.cfg.seq_len)
+        )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # one jitted decode program per (shape, sampling) signature — seed
         # is a runtime argument so same-shape requests reuse the compile.
-        # LRU-bounded: the key embeds client-controlled values (shapes,
-        # temperature), so an unbounded dict would leak a compiled XLA
-        # program per novel request. Guarded: requests come from the HTTP
-        # thread pool and jax tracing is not re-entrant.
+        # On the bucketed path shapes are ladder-quantized, so the count is
+        # bounded by the ladder product; the legacy path embeds client-
+        # controlled exact shapes, so the dict stays LRU-bounded to keep a
+        # novel-shape request stream from leaking compiled XLA programs.
+        # Guarded by _lock: jax tracing is not re-entrant, and execution
+        # comes from both the decode worker and direct generate() callers.
         import collections
 
         self._compiled: collections.OrderedDict = collections.OrderedDict()
         self._compiled_max = 32
         self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.compile_count = 0  # programs BUILT (cache misses), ever
+        self.requests_served = 0
+        self._coalescer: Optional[DecodeCoalescer] = None
+        if self.config.batching:
+            self._coalescer = DecodeCoalescer(
+                self._execute_group,
+                max_batch=self.config.max_batch,
+                max_wait_ms=self.config.max_wait_ms,
+            )
+
+    # ------------------------------------------------------- compiled cache
+    def _cached(self, key, build):
+        """LRU lookup/insert; counts builds (the compile-count telemetry
+        the bucket-sweep test pins). Callers hold _lock."""
+        fn = self._compiled.get(key)
+        if fn is not None:
+            self._compiled.move_to_end(key)
+            return fn
+        fn = build()
+        self.compile_count += 1
+        self._compiled[key] = fn
+        while len(self._compiled) > self._compiled_max:
+            self._compiled.popitem(last=False)
+        return fn
 
     def _decode_fn(
         self, batch, prompt_len, max_new, temperature, top_k, eos_id,
         num_beams=1, length_penalty=1.0,
     ):
+        """Legacy exact-shape program: sampling per (batch, P, new,
+        sampling) signature, or beam search (which ignores temperature/
+        top_k; sampling ignores length_penalty — normalize the key so
+        equivalent requests don't compile duplicate programs)."""
         import jax
 
         from ..models.generate import beam_search, generate
 
-        # normalize the key to what the chosen path actually uses —
-        # beam search ignores temperature/top_k, sampling ignores
-        # length_penalty; without this, equivalent requests compile
-        # byte-identical duplicate programs and churn the LRU
         if num_beams > 1:
             temperature, top_k = 0.0, None
         else:
             length_penalty = 1.0
         key = (
-            batch, prompt_len, max_new, temperature, top_k, eos_id,
+            "exact", batch, prompt_len, max_new, temperature, top_k, eos_id,
             num_beams, length_penalty,
         )
-        fn = self._compiled.get(key)
-        if fn is not None:
-            self._compiled.move_to_end(key)
-        if fn is None:
+
+        def build():
             if num_beams > 1:
-                fn = jax.jit(
+                return jax.jit(
                     lambda params, prompt, seed: beam_search(
                         self.module,
                         params,
@@ -127,23 +196,50 @@ class ModelServer:
                         eos_id=eos_id,
                     )
                 )
-            else:
-                fn = jax.jit(
-                    lambda params, prompt, seed: generate(
-                        self.module,
-                        params,
-                        prompt,
-                        max_new_tokens=max_new,
-                        temperature=temperature,
-                        top_k=top_k,
-                        eos_id=eos_id,
-                        seed=seed,
-                    )
+            return jax.jit(
+                lambda params, prompt, seed: generate(
+                    self.module,
+                    params,
+                    prompt,
+                    max_new_tokens=max_new,
+                    temperature=temperature,
+                    top_k=top_k,
+                    eos_id=eos_id,
+                    seed=seed,
                 )
-            self._compiled[key] = fn
-            while len(self._compiled) > self._compiled_max:
-                self._compiled.popitem(last=False)
-        return fn
+            )
+
+        return self._cached(key, build)
+
+    def _bucketed_fn(self, batch, prompt_bucket, new_bucket, temperature, top_k, eos_id):
+        """Bucketed program: prompt_lengths and per-row seeds are runtime
+        [B] arguments, so every true length/seed mix in the bucket reuses
+        this one compile."""
+        import jax
+
+        from ..models.generate import generate
+
+        key = (
+            "bucket", batch, prompt_bucket, new_bucket, temperature, top_k,
+            eos_id,
+        )
+
+        def build():
+            return jax.jit(
+                lambda params, prompt, lengths, seeds: generate(
+                    self.module,
+                    params,
+                    prompt,
+                    max_new_tokens=new_bucket,
+                    temperature=temperature,
+                    top_k=top_k,
+                    eos_id=eos_id,
+                    seed=seeds,
+                    prompt_lengths=lengths,
+                )
+            )
+
+        return self._cached(key, build)
 
     # ------------------------------------------------------------ loading
     @classmethod
@@ -152,6 +248,7 @@ class ModelServer:
         run_ref: str,
         store: Optional[RunStore] = None,
         mesh_axes: Optional[dict] = None,
+        config: Optional[ServingConfig] = None,
     ):
         """Restore the latest checkpoint of a `transformer_lm` jaxjob run.
 
@@ -166,7 +263,11 @@ class ModelServer:
         `mesh_axes` (e.g. {"model": 4}) shards the restored params over a
         device mesh for models too big for one chip — decode is unchanged,
         XLA inserts the collectives from the param shardings (parity with
-        single-device decoding is tested)."""
+        single-device decoding is tested).
+
+        `config` overrides the batching knobs; absent, the stored spec's
+        `program.serving` section (schemas.run_kinds.V1ServingSpec)
+        provides defaults so a run can pin its own serving shape."""
         import jax
 
         from ..models import build_model
@@ -191,7 +292,12 @@ class ModelServer:
                 f"serving supports the LM family (transformer_lm), run "
                 f"{uuid[:8]} trained {program.model.name!r}"
             )
-        ckpt_dir = store.outputs_dir(uuid) / "checkpoints"
+        if config is None and program.serving is not None:
+            config = program.serving.to_config()
+        # absolute: orbax's CheckpointManager rejects relative paths, and a
+        # store rooted at a relative POLYAXON_HOME (CLI run from the store's
+        # parent dir) would otherwise fail only at serve time
+        ckpt_dir = (store.outputs_dir(uuid) / "checkpoints").resolve()
         if not ckpt_dir.is_dir():
             raise ServingError(
                 f"run {uuid[:8]} has no checkpoints under its outputs — "
@@ -229,11 +335,11 @@ class ModelServer:
             params,
             model_name=program.model.name,
             step=step,
+            config=config,
         )
 
-    # ------------------------------------------------------------ compute
-    def generate(self, body: dict) -> dict:
-        import jax.numpy as jnp
+    # --------------------------------------------------------- validation
+    def _validate(self, body: dict) -> dict:
         import numpy as np
 
         tokens = body.get("tokens")
@@ -271,28 +377,206 @@ class ModelServer:
             raise ServingError(
                 f"numBeams must be in [1, {max_beams}]"
             )
+        return {
+            "arr": arr,
+            "max_new": max_new,
+            "temperature": float(body.get("temperature", 0.0)),
+            "top_k": int(top_k) if top_k is not None else None,
+            "eos_id": int(eos) if eos is not None else None,
+            "num_beams": num_beams,
+            "length_penalty": float(body.get("lengthPenalty", 1.0)),
+            "seed": int(body.get("seed", 0)),
+        }
+
+    def _make_requests(self, req: dict) -> list[PendingRequest]:
+        """One PendingRequest PER ROW — rows of a multi-row body may land
+        in different prompt buckets and coalesce with different peers.
+        Row i samples from seed+i so identical rows still diverge (the
+        scalar-seed legacy path had the same property via shared-batch
+        sampling)."""
+        cfg = self.module.cfg
+        out = []
+        for i, row in enumerate(req["arr"]):
+            pb, nb = choose_buckets(
+                len(row),
+                req["max_new"],
+                self._prompt_ladder,
+                self._new_ladder,
+                int(cfg.seq_len),
+            )
+            key = GroupKey(
+                prompt_bucket=pb,
+                new_bucket=nb,
+                temperature=req["temperature"],
+                top_k=req["top_k"],
+                eos_id=req["eos_id"],
+            )
+            out.append(
+                PendingRequest(
+                    tokens=row.tolist(),
+                    prompt_len=len(row),
+                    max_new=req["max_new"],
+                    seed=req["seed"] + i,
+                    key=key,
+                )
+            )
+        return out
+
+    # ------------------------------------------------------------ compute
+    def _execute_group(self, batch: list[PendingRequest]):
+        """Run ONE coalesced group (same GroupKey) and scatter row results
+        back into each request. Called from the decode worker thread, or
+        inline by generate() — both under _lock for the jax part."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        key = batch[0].key
+        n = len(batch)
+        P, N = key.prompt_bucket, key.new_bucket
+        bb = batch_bucket(n, max(n, self.config.max_batch))
+        arr = np.zeros((bb, P), np.int32)
+        lengths = np.ones((bb,), np.int32)  # pad rows: dummy length-1 prompt
+        seeds = np.zeros((bb,), np.int32)
+        for i, r in enumerate(batch):
+            arr[i, P - r.prompt_len:] = r.tokens
+            lengths[i] = r.prompt_len
+            seeds[i] = r.seed
+        with self._lock:
+            fn = self._bucketed_fn(
+                bb, P, N, key.temperature, key.top_k, key.eos_id
+            )
+            out = np.asarray(
+                fn(
+                    self.params,
+                    jnp.asarray(arr),
+                    jnp.asarray(lengths),
+                    jnp.asarray(seeds),
+                )
+            )
+        for i, r in enumerate(batch):
+            pad = P - r.prompt_len
+            # truncate the bucketed tail to what the client asked for — a
+            # longer bucket's extra tokens are a strict continuation, so
+            # the first max_new are identical to an exact-shape run
+            r.finish(
+                result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
+            )
+        with self._stats_lock:
+            self.requests_served += n
+
+    def _execute_beam_group(self, batch: list[PendingRequest]):
+        """Beam requests keep the legacy exact-shape program (beam search
+        has no pad/per-row-seed path); same-shape requests still stack."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        key = batch[0].key
+        arr = np.stack([np.asarray(r.tokens, np.int32) for r in batch])
         with self._lock:
             fn = self._decode_fn(
-                arr.shape[0],
-                arr.shape[1],
-                max_new,
-                float(body.get("temperature", 0.0)),
-                int(top_k) if top_k is not None else None,
-                int(eos) if eos is not None else None,
-                num_beams=num_beams,
-                length_penalty=float(body.get("lengthPenalty", 1.0)),
+                arr.shape[0], arr.shape[1], key.new_bucket,
+                key.temperature, key.top_k, key.eos_id,
+                num_beams=key.num_beams, length_penalty=key.length_penalty,
             )
-            out = fn(
-                self.params,
-                jnp.asarray(arr),
-                jnp.asarray(int(body.get("seed", 0)), jnp.int32),
+            out = np.asarray(
+                fn(self.params, jnp.asarray(arr), jnp.asarray(0, jnp.int32))
             )
-        return {"tokens": np.asarray(out).tolist()}
+        for i, r in enumerate(batch):
+            r.finish(result=out[i].tolist())
+        with self._stats_lock:
+            self.requests_served += len(batch)
+
+    def _dispatch_group(self, batch: list[PendingRequest]):
+        if batch[0].key.num_beams > 1:
+            self._execute_beam_group(batch)
+        else:
+            self._execute_group(batch)
+
+    def generate(self, body: dict) -> dict:
+        """Synchronous single-caller path (also the CLI/test surface):
+        validates, then runs inline — bucketed when batching is enabled,
+        the legacy exact-shape program otherwise."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        req = self._validate(body)
+        arr = req["arr"]
+        if req["num_beams"] > 1 or not self.config.batching:
+            with self._lock:
+                fn = self._decode_fn(
+                    arr.shape[0],
+                    arr.shape[1],
+                    req["max_new"],
+                    req["temperature"],
+                    req["top_k"],
+                    req["eos_id"],
+                    num_beams=req["num_beams"],
+                    length_penalty=req["length_penalty"],
+                )
+                out = fn(
+                    self.params,
+                    jnp.asarray(arr),
+                    jnp.asarray(req["seed"], jnp.int32),
+                )
+            with self._stats_lock:
+                self.requests_served += arr.shape[0]
+            return {"tokens": np.asarray(out).tolist()}
+        rows = self._make_requests(req)
+        by_key: dict = {}
+        for r in rows:
+            by_key.setdefault(r.key, []).append(r)
+        for group in by_key.values():
+            self._dispatch_group(group)
+        return {"tokens": [r.result for r in rows]}
+
+    def handle_request(self, body: dict) -> dict:
+        """HTTP-path entry: producer side of the coalescer. Falls back to
+        the synchronous path for beams and when batching is off."""
+        req = self._validate(body)
+        if (
+            self._coalescer is None
+            or self._coalescer._thread is None
+            or req["num_beams"] > 1
+        ):
+            return self.generate(body)
+        rows = self._make_requests(req)
+        for r in rows:
+            self._coalescer.submit(r)
+        timeout = self.config.request_timeout_s
+        for r in rows:
+            if not r.done.wait(timeout):
+                raise TimeoutError(
+                    f"decode did not complete within {timeout:.0f}s"
+                )
+            if r.error is not None:
+                raise r.error
+        return {"tokens": [r.result for r in rows]}
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            served = self.requests_served
+        batches = rows = 0
+        if self._coalescer is not None:
+            batches = self._coalescer.batches_run
+            rows = self._coalescer.rows_run
+        return {
+            "batching": bool(self.config.batching),
+            "compile_count": self.compile_count,
+            "requests": served,
+            "batches": batches,
+            "mean_batch_occupancy": round(rows / batches, 3) if batches else None,
+            "prompt_buckets": list(self._prompt_ladder),
+            "max_new_buckets": list(self._new_ladder),
+            "max_batch": self.config.max_batch,
+            "max_wait_ms": self.config.max_wait_ms,
+        }
 
     # ------------------------------------------------------------ http
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start serving in a background thread; returns the bound port."""
         server = self
+        if self._coalescer is not None:
+            self._coalescer.start()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -316,6 +600,8 @@ class ModelServer:
                             "step": server.step,
                         },
                     )
+                elif self.path == "/statsz":
+                    self._send(200, server.stats())
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
@@ -326,7 +612,7 @@ class ModelServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
-                    self._send(200, server.generate(body))
+                    self._send(200, server.handle_request(body))
                 except ServingError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — surface, don't kill
@@ -344,3 +630,11 @@ class ModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._coalescer is not None:
+            self._coalescer.stop()
+            # a restarted server gets a fresh worker
+            self._coalescer = DecodeCoalescer(
+                self._execute_group,
+                max_batch=self.config.max_batch,
+                max_wait_ms=self.config.max_wait_ms,
+            )

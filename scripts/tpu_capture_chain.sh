@@ -82,20 +82,31 @@ run_bench_variant tpu_results/bench_tpu_gqa.json 1500 \
 # success rows carry "mode" right after the backend; error rows don't —
 # a sweep where every flash call failed must NOT count as chip evidence
 flash_ok='"backend": "flash", "mode"'
-if [ ! -f tpu_results/attention_tpu.jsonl ] || \
-   ! grep -q "$flash_ok" tpu_results/attention_tpu.jsonl; then
-  probe || { note "tunnel down before attention bench"; exit 0; }
-  note "running attention_bench (budget 1500s)"
-  timeout 1500 python benchmarks/attention_bench.py \
-    > tpu_results/attention_tpu.jsonl.tmp 2>> "$log"
-  note "attention rc=$?"
-  if grep -q "$flash_ok" tpu_results/attention_tpu.jsonl.tmp 2>/dev/null
-  then
-    mv tpu_results/attention_tpu.jsonl.tmp tpu_results/attention_tpu.jsonl
+attn=tpu_results/attention_tpu.jsonl
+
+# Commit whatever chip-measured attention rows are on disk. The bench
+# appends each line to $attn AS IT COMPLETES (--out, no .tmp indirection):
+# round 5 lost a corrected flash-vs-XLA sweep because the window died
+# before a final tmp->jsonl rename and the .tmp was gitignored. Partial
+# evidence is evidence — the next window's run resumes past it.
+commit_attention() {
+  if grep -q '"platform": "tpu"' "$attn" 2>/dev/null; then
     commit_evidence "Record TPU attention backend bench (canary chain)"
   else
-    rm -f tpu_results/attention_tpu.jsonl.tmp
+    # never leave CPU or all-error rows under a _tpu filename
+    rm -f "$attn"
   fi
+}
+
+if [ ! -f "$attn" ] || ! grep -q "$flash_ok" "$attn"; then
+  probe || { note "tunnel down before attention bench"; exit 0; }
+  note "running attention_bench (budget 1500s)"
+  trap 'note "interrupted during attention bench"; commit_attention' INT TERM EXIT
+  timeout 1500 python benchmarks/attention_bench.py --out "$attn" \
+    >> "$log" 2>&1
+  note "attention rc=$?"
+  trap - INT TERM EXIT
+  commit_attention
 else
   note "skip attention bench (already captured)"
 fi
