@@ -209,16 +209,24 @@ def _bare_loop(model_cfg: dict, batch: int, seq: int, steps: int) -> float:
 
 def _step_flops(trainer) -> float | None:
     """Analytic transformer train-step FLOPs: 6·N per token (fwd+bwd) plus
-    the 12·L·d·s attention-score term. (XLA's cost_analysis would need a
-    second full compile of the step — not worth minutes of bench time for
-    a number the analytic formula gives within a few percent.)"""
+    the 12·L·d·s attention-score term, via the shared formula in
+    polyaxon_tpu.telemetry. (XLA's cost_analysis would need a second full
+    compile of the step — not worth minutes of bench time for a number
+    the analytic formula gives within a few percent.)"""
     try:
         import jax
 
+        from polyaxon_tpu.telemetry import train_step_flops
+
         cfg = trainer.bundle.module.cfg
         n_params = sum(x.size for x in jax.tree.leaves(trainer.state.params))
-        tokens = trainer.data.batch_size * cfg.seq_len
-        return (6 * n_params + 12 * cfg.n_layers * cfg.dim * cfg.seq_len) * tokens
+        return train_step_flops(
+            n_params=n_params,
+            n_layers=cfg.n_layers,
+            dim=cfg.dim,
+            seq_len=cfg.seq_len,
+            tokens=trainer.data.batch_size * cfg.seq_len,
+        )
     except Exception:  # noqa: BLE001
         return None
 

@@ -30,6 +30,18 @@ def _sync(out) -> float:
     return float(leaf.ravel()[0])
 
 
+def summarize(samples_s) -> dict:
+    """Distribution summary (count/mean/p50/p95/p99) of per-call latency
+    samples. Delegates to `polyaxon_tpu.telemetry.summarize` — the one
+    percentile implementation, shared with the servers' /statsz — so the
+    benches and the serving layer can never disagree on what a percentile
+    means. Bench scripts run with the repo root on sys.path, so the
+    package import resolves."""
+    from polyaxon_tpu.telemetry import summarize as _summarize
+
+    return _summarize(list(samples_s))
+
+
 def time_call(fn, *args, iters: int = 20) -> float:
     """Mean wall time per call over `iters` calls; one warmup call runs
     first so compile time is excluded."""

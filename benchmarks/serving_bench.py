@@ -32,7 +32,6 @@ from __future__ import annotations
 import argparse
 import json
 import random
-import statistics
 import sys
 import threading
 import time
@@ -40,6 +39,8 @@ import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from polyaxon_tpu.telemetry import quantile  # noqa: E402 (needs sys.path)
 
 MODEL_CFG = {
     "preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
@@ -167,10 +168,8 @@ def drive(mode: str, traffic: list[dict], clients: int, max_batch: int,
         "clients": clients,
         "requests": len(latencies),
         "wall_s": round(wall, 2),
-        "p50_ms": round(statistics.median(lat_ms), 1) if lat_ms else None,
-        "p95_ms": (
-            round(lat_ms[int(0.95 * (len(lat_ms) - 1))], 1) if lat_ms else None
-        ),
+        "p50_ms": round(quantile(lat_ms, 0.5), 1) if lat_ms else None,
+        "p95_ms": round(quantile(lat_ms, 0.95), 1) if lat_ms else None,
         "compile_count": stats["compile_count"],
         "batches": stats["batches"],
         "mean_batch_occupancy": stats["mean_batch_occupancy"],

@@ -147,6 +147,74 @@ def check(fpath):
     click.echo(json.dumps(compiled.to_dict(), indent=1, default=str))
 
 
+@cli.command()
+@click.argument("run_ref")
+@click.option("--spans", "n_spans", default=12, show_default=True,
+              help="recent telemetry spans to show")
+@click.option("--events", "n_events", default=6, show_default=True,
+              help="recent lifecycle events to show")
+def stats(run_ref, n_spans, n_events):
+    """Live metrics and recent spans of a run, from the run store.
+
+    Metrics fold to their latest value (training and sys.* monitor
+    samples interleave in one stream); spans come from the trainer's
+    telemetry export (<outputs>/telemetry/spans.jsonl)."""
+    from ..store.local import UnknownRunError
+
+    store = RunStore()
+    try:
+        uuid = store.resolve(run_ref)
+    except UnknownRunError as e:
+        raise click.ClickException(str(e.args[0]) if e.args else str(e))
+    status = store.get_status(uuid)
+    click.echo(f"run {uuid[:8]}  status={status.get('status', '?')}")
+    folded: dict = {}
+    step = None
+    for rec in store.read_metrics(uuid):
+        is_training = any(
+            k not in ("step", "ts") and not k.startswith("sys.") for k in rec
+        )
+        for k, v in rec.items():
+            if k == "step":
+                if is_training and v is not None:
+                    step = max(step or 0, int(v))
+            elif k != "ts":
+                folded[k] = v
+    if folded:
+        at = "" if step is None else f" (train step {step})"
+        click.echo(f"\nmetrics, latest value per series{at}:")
+        for k in sorted(folded):
+            v = folded[k]
+            val = f"{v:.6g}" if isinstance(v, (int, float)) else str(v)
+            click.echo(f"  {k:<32} {val}")
+    spans_path = store.outputs_dir(uuid) / "telemetry" / "spans.jsonl"
+    if spans_path.exists():
+        lines = spans_path.read_text().splitlines()[-max(1, n_spans):]
+        click.echo(f"\nspans, last {len(lines)}:")
+        for ln in lines:
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            attrs = " ".join(
+                f"{k}={v}" for k, v in (rec.get("attrs") or {}).items()
+            )
+            indent = "  " if rec.get("parent_id") else ""
+            click.echo(
+                f"  {indent}{rec.get('name', '?'):<14} "
+                f"{(rec.get('dur_s') or 0) * 1e3:10.3f} ms  {attrs}"
+            )
+    events = store.read_events(uuid)
+    if events:
+        click.echo(f"\nevents, last {min(max(1, n_events), len(events))}:")
+        for ev in events[-max(1, n_events):]:
+            body = {k: v for k, v in ev.items() if k not in ("kind", "ts")}
+            click.echo(
+                f"  {ev.get('kind', '?'):<20} "
+                f"{json.dumps(body, default=str)[:120]}"
+            )
+
+
 class _RunRefGroup(click.Group):
     """Unknown run refs surface as clean CLI errors, not the store's raw
     traceback — every ops subcommand resolves a uid. Only the dedicated

@@ -125,6 +125,8 @@ class RetryPolicy:
         `on_retry(attempt, delay, exc)` observes each backoff for logging."""
         if retryable is None:
             retryable = lambda e: classify(e) != PERMANENT  # noqa: E731
+        from .telemetry import get_registry
+
         attempt = 0
         while True:
             try:
@@ -134,6 +136,10 @@ class RetryPolicy:
                     raise
                 d = self.delay(attempt, seed=seed)
                 attempt += 1
+                get_registry().counter(
+                    "retry.attempts",
+                    help="Retries taken under RetryPolicy.call, all layers",
+                ).inc()
                 if on_retry is not None:
                     on_retry(attempt, d, e)
                 if d > 0:

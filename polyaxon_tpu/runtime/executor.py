@@ -113,11 +113,17 @@ class Executor:
                 if isinstance(e, KeyboardInterrupt):
                     store.request_stop(run_uuid)
                     raise
+                from ..telemetry import get_registry
+
                 kind = classify(e)
                 if kind == PREEMPTED:
                     # the program was healthy; the machine went away. Restart
                     # from checkpoint WITHOUT burning the retry budget.
                     restarts += 1
+                    get_registry().counter(
+                        "runs.preemptions",
+                        help="Budget-free preemption restarts",
+                    ).inc()
                     store.log_event(
                         run_uuid,
                         "preempted",
@@ -137,6 +143,9 @@ class Executor:
                     delay = policy.delay(attempt, seed=run_uuid)
                     attempt += 1
                     restarts += 1
+                    get_registry().counter(
+                        "runs.retries", help="Budgeted transient-failure retries"
+                    ).inc()
                     store.log_event(
                         run_uuid,
                         "retry",
@@ -601,9 +610,21 @@ class Executor:
             artifacts_dir=str(store.outputs_dir(run_uuid)),
         )
         store.set_status(run_uuid, V1Statuses.RUNNING)
+        # opt-in system sampling: an `observability:` section in the spec
+        # starts the host/HBM monitor at its cadence for this run
+        monitor = None
+        obs = program.observability
+        if obs is not None:
+            from ..tracking.monitors import SystemMonitor
+
+            monitor = SystemMonitor(
+                store, run_uuid, interval=float(obs.sample_interval)
+            ).start()
         try:
             result = trainer.run()
         finally:
+            if monitor is not None:
+                monitor.stop()
             trainer.close()
         store.log_event(
             run_uuid,

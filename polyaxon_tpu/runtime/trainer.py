@@ -18,7 +18,6 @@ in user containers behind Kubeflow CRDs). TPU-first design decisions:
 from __future__ import annotations
 
 import dataclasses
-import time
 from pathlib import Path
 from typing import Any, Callable, Optional
 
@@ -42,6 +41,9 @@ from ..parallel.sharding import (
 )
 from ..retry import Preempted
 from ..schemas.run_kinds import V1Program
+from ..telemetry import MetricsRegistry, SpanTracer, now as _now
+from ..telemetry import mfu as _mfu_of
+from ..telemetry import train_step_flops
 from . import preemption
 
 
@@ -122,6 +124,7 @@ class Trainer:
         event_fn: Optional[Callable[[str, dict], None]] = None,
         checkpoint_dir: Optional[str] = None,
         artifacts_dir: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.artifacts_dir = artifacts_dir
         self.event_fn = event_fn
@@ -134,6 +137,21 @@ class Trainer:
         self.tspec = tspec
         self.log_fn = log_fn or (lambda step, m: None)
         self.checkpoint_dir = checkpoint_dir
+        # ONE metrics pipeline: every number the trainer reports flows
+        # through this registry (and from there to the store via _emit).
+        obs = program.observability
+        self.obs = obs
+        self.telemetry = registry or MetricsRegistry(
+            default_buckets=obs.histogram_buckets if obs else None
+        )
+        trace = obs.trace if obs is not None else True
+        self.tracer = SpanTracer(
+            path=(
+                str(Path(artifacts_dir) / "telemetry" / "spans.jsonl")
+                if (artifacts_dir and trace)
+                else None
+            )
+        )
 
         from ..utils.jax_platform import apply_compilation_cache
 
@@ -540,7 +558,7 @@ class Trainer:
             int(tspec.profile_start) if tspec.profile_start is not None else None
         )
         prof_stop = int(tspec.profile_stop) if tspec.profile_stop is not None else None
-        profiling = False
+        self._profiling = False
 
         # dispatch back-pressure: the async dispatch queue must stay bounded
         # or queued steps exhaust XLA's collective thread pool on multi-device
@@ -553,44 +571,81 @@ class Trainer:
         inflight: _collections.deque = _collections.deque()
         max_inflight = 4
 
-        t0 = time.perf_counter()
+        self._init_throughput_facts()
+        step_hist = self.telemetry.histogram(
+            "trainer.step_seconds", help="Per-step walltime"
+        )
+        wait_hist = self.telemetry.histogram(
+            "trainer.data_wait_seconds",
+            help="Per-step time blocked on the input pipeline",
+        )
+        busy_hist = self.telemetry.histogram(
+            "trainer.compute_seconds",
+            help="Per-step walltime minus data wait",
+        )
+        steps_ctr = self.telemetry.counter(
+            "trainer.steps", help="Training steps completed"
+        )
+        t0 = _now()
+        self._win = {"t0": t0, "steps": 0, "wait": 0.0, "busy": 0.0}
         for step in range(start_step, self.steps):
-            inject("trainer.step", step=step)
-            if preemption.requested():
-                self._preempt_exit(step, start_step)
-            if prof_start is not None and step == prof_start and self.artifacts_dir:
-                jax.profiler.start_trace(str(Path(self.artifacts_dir) / "profile"))
-                profiling = True
-            batch = feed.get()
-            if isinstance(batch, BaseException):
-                raise batch
-            self.state, metrics = self.train_step(self.state, batch)
-            inflight.append(metrics["loss"])
-            if len(inflight) > max_inflight:
-                inflight.popleft().block_until_ready()
-            if profiling and prof_stop is not None and step + 1 >= prof_stop:
-                jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
-                profiling = False
-            if (step + 1) % log_every == 0 or step + 1 == self.steps:
-                # flush the previous log point first: keeps one step of
-                # pipelining so logging never stalls the device queue
-                if pending is not None:
-                    self._emit(history, *pending)
-                pending = (step + 1, metrics)
-            if eval_every and ((step + 1) % eval_every == 0 or step + 1 == self.steps):
-                eval_metrics = self._evaluate(eval_steps)
-                if pending is not None:
-                    self._emit(history, *pending)
-                    pending = None
-                self._emit(history, step + 1, eval_metrics)
-            if ckpt_every and (step + 1) % ckpt_every == 0:
-                self.save(step + 1)
-        if profiling:
-            jax.profiler.stop_trace()
+            # two-level span tree per iteration: data_wait + compute cover
+            # the whole step body, so their durations sum to the step span
+            # (the invariant tests/test_telemetry.py pins within 10%)
+            with self.tracer.span("step", step=step) as step_span:
+                inject("trainer.step", step=step)
+                if preemption.requested():
+                    self._preempt_exit(step, start_step)
+                if prof_start is not None and step == prof_start and self.artifacts_dir:
+                    self._start_profiler()
+                with self.tracer.span("data_wait") as wait_span:
+                    batch = feed.get()
+                if isinstance(batch, BaseException):
+                    raise batch
+                with self.tracer.span("compute") as busy_span:
+                    self.state, metrics = self.train_step(self.state, batch)
+                    inflight.append(metrics["loss"])
+                    if len(inflight) > max_inflight:
+                        inflight.popleft().block_until_ready()
+                    if (
+                        self._profiling
+                        and prof_stop is not None
+                        and step + 1 >= prof_stop
+                    ):
+                        jax.block_until_ready(metrics["loss"])
+                        self._stop_profiler()
+                    if (step + 1) % log_every == 0 or step + 1 == self.steps:
+                        # flush the previous log point first: keeps one step
+                        # of pipelining so logging never stalls the device
+                        if pending is not None:
+                            self._emit(history, *pending)
+                        pending = (step + 1, metrics)
+                    if eval_every and (
+                        (step + 1) % eval_every == 0 or step + 1 == self.steps
+                    ):
+                        eval_metrics = self._evaluate(eval_steps)
+                        if pending is not None:
+                            self._emit(history, *pending)
+                            pending = None
+                        self._emit(history, step + 1, eval_metrics)
+                    if ckpt_every and (step + 1) % ckpt_every == 0:
+                        self.save(step + 1)
+            step_hist.observe(step_span.dur_s)
+            wait_hist.observe(wait_span.dur_s)
+            busy_hist.observe(busy_span.dur_s)
+            steps_ctr.inc()
+            self._win["steps"] += 1
+            self._win["wait"] += wait_span.dur_s
+            self._win["busy"] += busy_span.dur_s
+        # loop-exit guard: when the profiler window end coincides with the
+        # last step, the inner stop already ran — _stop_profiler is
+        # idempotent, so the capture is never double-closed (previously a
+        # raw second stop_trace() here raised out of an otherwise-healthy
+        # run)
+        self._stop_profiler()
         if pending is not None:
             self._emit(history, *pending)
-        elapsed = time.perf_counter() - t0
+        elapsed = _now() - t0
         steps_done = self.steps - start_step
         sps = steps_done / elapsed if elapsed > 0 else 0.0
         if self.checkpoint_dir and ckpt_every:
@@ -628,8 +683,97 @@ class Trainer:
                 totals[k] = totals.get(k, 0.0) + float(v)
         return {k: v / eval_steps for k, v in totals.items()}
 
+    # -------------------------------------------------------- telemetry
+    def _start_profiler(self):
+        if self._profiling:
+            return
+        trace_dir = Path(self.artifacts_dir) / "profile"
+        jax.profiler.start_trace(str(trace_dir))
+        self._profiling = True
+        self.tracer.event("profiler.start", path=str(trace_dir))
+
+    def _stop_profiler(self):
+        """Idempotent capture-window close; registers the emitted trace
+        directory as a run artifact so the profile is discoverable from
+        the run's events, not just by knowing the outputs layout."""
+        if not self._profiling:
+            return
+        jax.profiler.stop_trace()
+        self._profiling = False
+        trace_dir = Path(self.artifacts_dir) / "profile"
+        self.tracer.event("profiler.stop", path=str(trace_dir))
+        self._event(
+            "artifact",
+            {"kind": "profile", "path": "profile", "abs_path": str(trace_dir)},
+        )
+
+    def _init_throughput_facts(self):
+        """Static facts behind the tokens/s and MFU gauges: tokens per
+        step (token tasks only) and the analytic step FLOPs (transformer
+        cfg only) — None disables the corresponding gauge rather than
+        reporting a wrong number."""
+        self._tokens_per_step = None
+        self._flops_per_step = None
+        cfg = getattr(self.bundle.module, "cfg", None)
+        if self.bundle.task not in ("lm", "mlm") or cfg is None:
+            return
+        seq = self.data.meta.get("seq_len") or getattr(cfg, "seq_len", None)
+        if not seq:
+            return
+        global_batch = self.data.batch_size * jax.process_count()
+        self._tokens_per_step = global_batch * int(seq)
+        try:
+            n_params = sum(
+                x.size for x in jax.tree.leaves(self.state.params)
+            )
+            self._flops_per_step = train_step_flops(
+                n_params, cfg.n_layers, cfg.dim, cfg.seq_len,
+                self._tokens_per_step,
+            )
+        except (AttributeError, TypeError):
+            pass
+
+    def _drain_window(self) -> dict:
+        """Derived rates since the last log point: steps/s, tokens/s, MFU
+        against the device generation's peak FLOPs, and the fraction of
+        walltime blocked on the input pipeline. Resets the window, so an
+        eval emit immediately after a train emit adds nothing."""
+        w = self._win
+        dt = _now() - w["t0"]
+        if not w["steps"] or dt <= 0:
+            return {}
+        out = {}
+        sps = w["steps"] / dt
+        busy = w["wait"] + w["busy"]
+        if busy > 0:
+            out["data_wait_frac"] = w["wait"] / busy
+        if self._tokens_per_step:
+            out["tokens_per_sec"] = sps * self._tokens_per_step
+        if self._flops_per_step:
+            mfu = _mfu_of(
+                sps * self._flops_per_step,
+                jax.devices()[0].device_kind,
+                jax.device_count(),
+            )
+            if mfu is not None:
+                out["mfu"] = mfu
+        self._win = {"t0": _now(), "steps": 0, "wait": 0.0, "busy": 0.0}
+        return out
+
+    def _hbm_gauges(self):
+        """Device HBM occupancy via memory_stats() — registry gauges only
+        (the per-run store copies stay SystemMonitor's job)."""
+        from ..tracking.monitors import device_metrics
+
+        for name, val in device_metrics().items():
+            self.telemetry.gauge(name).set(val)
+
     def _emit(self, history, step, metrics):
         vals = {k: float(v) for k, v in metrics.items()}
+        vals.update(self._drain_window())
+        for k, v in vals.items():
+            self.telemetry.gauge(f"train.{k}").set(v)
+        self._hbm_gauges()
         history.append({"step": step, **vals})
         self.log_fn(step, vals)
 

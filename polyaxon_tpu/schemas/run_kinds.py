@@ -166,6 +166,40 @@ class V1ServingSpec(BaseSchema):
         )
 
 
+class V1ObservabilitySpec(BaseSchema):
+    """Telemetry knobs (polyaxon_tpu/telemetry/) a run can pin in its
+    spec. Presence of the section also opts the run into host/HBM
+    sampling (tracking/monitors.SystemMonitor) at `sampleInterval`."""
+
+    # SystemMonitor cadence, seconds
+    sample_interval: float | str = 10.0
+    # histogram bucket upper bounds (seconds, ascending) for the trainer's
+    # registry; None = the registry's latency-shaped defaults
+    histogram_buckets: Optional[list[float]] = None
+    # span tracing on/off: the per-step data_wait/compute span tree
+    # exported to <artifacts>/telemetry/spans.jsonl
+    trace: bool = True
+
+    @model_validator(mode="after")
+    def _check(self):
+        if (
+            isinstance(self.sample_interval, (int, float))
+            and self.sample_interval <= 0
+        ):
+            raise ValueError(
+                f"sampleInterval must be > 0, got {self.sample_interval}"
+            )
+        b = self.histogram_buckets
+        if b is not None and (
+            not b or any(x <= 0 for x in b) or sorted(set(b)) != list(b)
+        ):
+            raise ValueError(
+                "histogramBuckets must be a strictly ascending list of "
+                f"positive numbers, got {b}"
+            )
+        return self
+
+
 class V1Program(BaseSchema):
     """Native training program executed in-process by the JAXJob runtime
     (runtime/trainer.py) — this replaces the reference's user-container +
@@ -176,6 +210,7 @@ class V1Program(BaseSchema):
     optimizer: Optional[V1OptimizerSpec] = None
     train: Optional[V1TrainSpec] = None
     serving: Optional[V1ServingSpec] = None
+    observability: Optional[V1ObservabilitySpec] = None
 
 
 class V1MeshSpec(BaseSchema):

@@ -11,7 +11,10 @@ command:
 Endpoints:
   GET  /healthz           → {"status": "ok", "model": ..., "step": N}
   GET  /statsz            → {"compile_count": N, "requests": N,
-                             "batches": N, "mean_batch_occupancy": x, ...}
+                             "batches": N, "mean_batch_occupancy": x,
+                             "latency_ms": {p50/p95/p99}, ...}
+  GET  /metricsz          → Prometheus text format, rendered from the
+                             same telemetry registry as /statsz
   POST /generate          → {"tokens": [[...]]}
      body: {"tokens": [[int]], "maxNewTokens": int, "temperature": float,
             "topK": int?, "eosId": int?, "seed": int?,
@@ -44,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..store.local import RunStore
+from ..telemetry import MetricsRegistry, now as _now
 from .batching import (
     DecodeCoalescer,
     GroupKey,
@@ -112,12 +116,44 @@ class ModelServer:
         model_name: str = "?",
         step: int = 0,
         config: Optional[ServingConfig] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.module = module
         self.params = params
         self.model_name = model_name
         self.step = step
         self.config = config or ServingConfig()
+        # ONE metrics pipeline: /statsz and /metricsz both render from
+        # this registry, so the two surfaces cannot drift (pinned by
+        # tests/test_telemetry.py). A server defaults to its own registry
+        # — one server per process in production, isolated in tests.
+        self.telemetry = registry or MetricsRegistry()
+        self._m_requests = self.telemetry.counter(
+            "serving.requests", help="Generation rows served"
+        )
+        self._m_batches = self.telemetry.counter(
+            "serving.batches", help="Decode batches dispatched"
+        )
+        self._m_cache_hits = self.telemetry.counter(
+            "serving.compile_cache_hits", help="Compiled-program cache hits"
+        )
+        self._m_cache_misses = self.telemetry.counter(
+            "serving.compile_cache_misses",
+            help="Compiled-program cache misses (programs built)",
+        )
+        self._m_latency = self.telemetry.histogram(
+            "serving.request_seconds",
+            help="End-to-end request latency, seconds",
+        )
+        self._m_queue_wait = self.telemetry.histogram(
+            "serving.queue_wait_seconds",
+            help="Submit-to-dispatch wait in the coalescer queue, seconds",
+        )
+        self._m_occupancy = self.telemetry.histogram(
+            "serving.batch_occupancy",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+            help="Rows per dispatched decode batch",
+        )
         self._prompt_ladder, self._new_ladder = self.config.ladders(
             int(module.cfg.seq_len)
         )
@@ -136,9 +172,6 @@ class ModelServer:
         self._compiled: collections.OrderedDict = collections.OrderedDict()
         self._compiled_max = 32
         self._lock = threading.Lock()
-        self._stats_lock = threading.Lock()
-        self.compile_count = 0  # programs BUILT (cache misses), ever
-        self.requests_served = 0
         self._coalescer: Optional[DecodeCoalescer] = None
         if self.config.batching:
             self._coalescer = DecodeCoalescer(
@@ -147,16 +180,28 @@ class ModelServer:
                 max_wait_ms=self.config.max_wait_ms,
             )
 
+    @property
+    def compile_count(self) -> int:
+        """Programs BUILT (cache misses), ever — the bound the
+        bucket-sweep test pins."""
+        return int(self._m_cache_misses.value)
+
+    @property
+    def requests_served(self) -> int:
+        return int(self._m_requests.value)
+
     # ------------------------------------------------------- compiled cache
     def _cached(self, key, build):
-        """LRU lookup/insert; counts builds (the compile-count telemetry
-        the bucket-sweep test pins). Callers hold _lock."""
+        """LRU lookup/insert; counts hits/misses into the registry (a miss
+        is a program build — the compile-count telemetry the bucket-sweep
+        test pins). Callers hold _lock."""
         fn = self._compiled.get(key)
         if fn is not None:
             self._compiled.move_to_end(key)
+            self._m_cache_hits.inc()
             return fn
         fn = build()
-        self.compile_count += 1
+        self._m_cache_misses.inc()
         self._compiled[key] = fn
         while len(self._compiled) > self._compiled_max:
             self._compiled.popitem(last=False)
@@ -427,11 +472,18 @@ class ModelServer:
         """Run ONE coalesced group (same GroupKey) and scatter row results
         back into each request. Called from the decode worker thread, or
         inline by generate() — both under _lock for the jax part."""
+        import time as _time
+
         import jax.numpy as jnp
         import numpy as np
 
         key = batch[0].key
         n = len(batch)
+        qnow = _time.monotonic()  # same clock as PendingRequest.enqueued_at
+        for r in batch:
+            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+        self._m_occupancy.observe(n)
+        self._m_batches.inc()
         P, N = key.prompt_bucket, key.new_bucket
         bb = batch_bucket(n, max(n, self.config.max_batch))
         arr = np.zeros((bb, P), np.int32)
@@ -461,8 +513,7 @@ class ModelServer:
             r.finish(
                 result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
             )
-        with self._stats_lock:
-            self.requests_served += n
+        self._m_requests.inc(n)
 
     def _execute_beam_group(self, batch: list[PendingRequest]):
         """Beam requests keep the legacy exact-shape program (beam search
@@ -472,6 +523,8 @@ class ModelServer:
 
         key = batch[0].key
         arr = np.stack([np.asarray(r.tokens, np.int32) for r in batch])
+        self._m_occupancy.observe(len(batch))
+        self._m_batches.inc()
         with self._lock:
             fn = self._decode_fn(
                 arr.shape[0], arr.shape[1], key.new_bucket,
@@ -483,8 +536,7 @@ class ModelServer:
             )
         for i, r in enumerate(batch):
             r.finish(result=out[i].tolist())
-        with self._stats_lock:
-            self.requests_served += len(batch)
+        self._m_requests.inc(len(batch))
 
     def _dispatch_group(self, batch: list[PendingRequest]):
         if batch[0].key.num_beams > 1:
@@ -518,8 +570,7 @@ class ModelServer:
                     jnp.asarray(arr),
                     jnp.asarray(req["seed"], jnp.int32),
                 )
-            with self._stats_lock:
-                self.requests_served += arr.shape[0]
+            self._m_requests.inc(arr.shape[0])
             return {"tokens": np.asarray(out).tolist()}
         rows = self._make_requests(req)
         by_key: dict = {}
@@ -531,7 +582,16 @@ class ModelServer:
 
     def handle_request(self, body: dict) -> dict:
         """HTTP-path entry: producer side of the coalescer. Falls back to
-        the synchronous path for beams and when batching is off."""
+        the synchronous path for beams and when batching is off. End-to-end
+        latency (validate → all rows scattered back) lands in the
+        request-seconds histogram either way."""
+        t0 = _now()
+        try:
+            return self._handle_request(body)
+        finally:
+            self._m_latency.observe(_now() - t0)
+
+    def _handle_request(self, body: dict) -> dict:
         req = self._validate(body)
         if (
             self._coalescer is None
@@ -552,19 +612,35 @@ class ModelServer:
                 raise r.error
         return {"tokens": [r.result for r in rows]}
 
+    @staticmethod
+    def _ms(v) -> Optional[float]:
+        return round(v * 1e3, 3) if v is not None else None
+
     def stats(self) -> dict:
-        with self._stats_lock:
-            served = self.requests_served
         batches = rows = 0
         if self._coalescer is not None:
             batches = self._coalescer.batches_run
             rows = self._coalescer.rows_run
+        lat = self._m_latency.summary()
+        queue = self._m_queue_wait.summary()
         return {
             "batching": bool(self.config.batching),
             "compile_count": self.compile_count,
-            "requests": served,
+            "compile_cache": {
+                "hits": int(self._m_cache_hits.value),
+                "misses": int(self._m_cache_misses.value),
+            },
+            "requests": self.requests_served,
             "batches": batches,
             "mean_batch_occupancy": round(rows / batches, 3) if batches else None,
+            # percentiles estimated from the same histograms /metricsz
+            # exposes — the two surfaces stay in sync by construction
+            "latency_ms": {
+                k: self._ms(lat[k]) for k in ("p50", "p95", "p99", "mean")
+            },
+            "queue_wait_ms": {
+                k: self._ms(queue[k]) for k in ("p50", "p95", "p99", "mean")
+            },
             "prompt_buckets": list(self._prompt_ladder),
             "max_new_buckets": list(self._new_ladder),
             "max_batch": self.config.max_batch,
@@ -583,9 +659,13 @@ class ModelServer:
                 pass
 
             def _send(self, code: int, payload: dict):
-                data = json.dumps(payload).encode()
+                self._send_raw(
+                    code, json.dumps(payload).encode(), "application/json"
+                )
+
+            def _send_raw(self, code: int, data: bytes, ctype: str):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -602,6 +682,12 @@ class ModelServer:
                     )
                 elif self.path == "/statsz":
                     self._send(200, server.stats())
+                elif self.path == "/metricsz":
+                    self._send_raw(
+                        200,
+                        server.telemetry.render_prometheus().encode(),
+                        "text/plain; version=0.0.4",
+                    )
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

@@ -109,6 +109,15 @@ class RunStore:
         data["status"] = status
         data["conditions"].append(_condition(status, reason, message))
         _write_json(path, data)
+        # the single transition choke point: every lifecycle move in this
+        # process lands in the global registry (scraped at /metricsz)
+        from ..telemetry import get_registry
+
+        reg = get_registry()
+        reg.counter(
+            "runs.transitions", help="Run status transitions, all statuses"
+        ).inc()
+        reg.counter(f"runs.transitions.{V1Statuses(status).value}").inc()
 
     def get_status(self, run_uuid: str) -> dict:
         return _read_json(self.run_dir(run_uuid) / "status.json") or {}

@@ -61,6 +61,17 @@ def spec() -> dict:
                     "responses": {"200": {"description": "ok"}},
                 }
             },
+            "/metricsz": {
+                "get": {
+                    "summary": "Process metrics, Prometheus text format",
+                    "responses": {
+                        "200": {
+                            "description": "metrics exposition",
+                            "content": {"text/plain": {}},
+                        }
+                    },
+                }
+            },
             "/runs": {
                 "get": {
                     "summary": "List runs",

@@ -90,6 +90,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, INDEX_HTML.encode(), "text/html")
             if parts == ["healthz"]:
                 return self._send(200, _json_bytes({"status": "ok"}))
+            if parts == ["metricsz"]:
+                # process-wide registry: run-store transitions, retry/
+                # backoff counters, chaos injections (telemetry package)
+                from ..telemetry import get_registry
+
+                return self._send(
+                    200,
+                    get_registry().render_prometheus().encode(),
+                    "text/plain; version=0.0.4",
+                )
             if parts == ["openapi.json"]:
                 from .openapi import spec as openapi_spec
 

@@ -1,0 +1,55 @@
+"""Unified telemetry: ONE metrics pipeline + span tracing for every layer.
+
+The paper's platform treats observability as a first-class subsystem
+(Traceml-style monitors, SURVEY.md §2); before this package the
+reproduction had ad-hoc fragments — the trainer hand-rolled walltime
+math, serving counted compiles in an instance attribute, the system
+monitor wrote straight to the store. Everything now flows through:
+
+- `MetricsRegistry` — thread-safe counters / gauges / fixed-bucket
+  histograms with p50/p95/p99 summaries, rendered as a snapshot dict
+  (`/statsz`) or Prometheus text exposition (`/metricsz`). Both surfaces
+  read the SAME registry, so they cannot drift.
+- `SpanTracer` — context-manager spans with parent/child nesting,
+  exported as JSONL into the run's artifacts dir next to the
+  jax.profiler trace.
+- `quantile`/`summarize` — the one exact-percentile implementation
+  (benchmarks used to each carry their own).
+- `now()` — the sanctioned monotonic clock for metrics timing. No other
+  module in the package may call `time.perf_counter()` directly
+  (enforced by scripts/lint_telemetry.py and tests/test_telemetry.py).
+
+Process-global `get_registry()`/`get_tracer()` serve cross-cutting
+layers (run-store transitions, retry/backoff, chaos injections);
+components that live one-per-process in production (Trainer,
+ModelServer) default to a private registry so tests stay isolated.
+
+Import cost is stdlib-only — safe to import from anywhere in the
+package without cycles.
+"""
+
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    now,
+)
+from .spans import SpanTracer, get_tracer
+from .stats import mfu, quantile, summarize, train_step_flops
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanTracer",
+    "get_registry",
+    "get_tracer",
+    "mfu",
+    "now",
+    "quantile",
+    "summarize",
+    "train_step_flops",
+]

@@ -1,0 +1,155 @@
+"""Lightweight span tracing: context-manager spans with parent/child
+nesting, a bounded in-memory ring, and streaming JSONL export.
+
+A span is one timed region; nesting is tracked per-thread (a span opened
+while another is active records it as parent), so trainer code like
+
+    with tracer.span("step", step=i):
+        with tracer.span("data_wait"):
+            batch = feed.get()
+        with tracer.span("compute"):
+            ...
+
+produces a two-level tree per step. Completed spans append to
+`spans.jsonl` (one JSON object per line) when the tracer has a path —
+the trainer points it into the run's artifacts dir next to the
+jax.profiler trace, so both timing views travel with the run. Instant
+`event()` records share the file with `"kind": "event"`.
+
+Export schema per line:
+    {"kind": "span"|"event", "name": str, "span_id": int,
+     "parent_id": int|null, "ts": float (unix), "dur_s": float,
+     "attrs": {...}}
+
+Durations come from the monotonic metrics clock (registry.now); `ts` is
+wall-clock so lines are correlatable with logs and store events.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Optional
+
+from .registry import now
+
+
+class _SpanHandle:
+    """Context manager for one in-flight span; attrs may be added while
+    open via `set(...)`."""
+
+    def __init__(self, tracer: "SpanTracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(tracer._ids)
+        self.parent_id: Optional[int] = None
+        self.ts = 0.0
+        self._t0 = 0.0
+        self.dur_s: Optional[float] = None
+
+    def set(self, **attrs) -> "_SpanHandle":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_SpanHandle":
+        stack = self.tracer._stack()
+        self.parent_id = stack[-1].span_id if stack else None
+        stack.append(self)
+        self.ts = time.time()
+        self._t0 = now()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.dur_s = now() - self._t0
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # defensive: mis-nested exit
+            stack.remove(self)
+        self.tracer._record(
+            {
+                "kind": "span",
+                "name": self.name,
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "ts": self.ts,
+                "dur_s": self.dur_s,
+                "attrs": self.attrs,
+            }
+        )
+
+
+class SpanTracer:
+    """Per-component tracer. `path=None` keeps spans only in the memory
+    ring (`recent()`); with a path every completed record is also
+    appended to the JSONL file (parent dirs created lazily). Export
+    failures are swallowed after the first — tracing is advisory and
+    must never fail the traced work."""
+
+    def __init__(self, path: Optional[str] = None, capacity: int = 512):
+        self._path = Path(path) if path else None
+        self._ring: deque = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._write_lock = threading.Lock()
+        self._broken = False
+
+    @property
+    def path(self) -> Optional[Path]:
+        return self._path
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def span(self, name: str, **attrs) -> _SpanHandle:
+        return _SpanHandle(self, name, attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        """Instant (zero-duration) record."""
+        stack = self._stack()
+        self._record(
+            {
+                "kind": "event",
+                "name": name,
+                "span_id": next(self._ids),
+                "parent_id": stack[-1].span_id if stack else None,
+                "ts": time.time(),
+                "dur_s": 0.0,
+                "attrs": attrs,
+            }
+        )
+
+    def _record(self, rec: dict) -> None:
+        self._ring.append(rec)
+        if self._path is None or self._broken:
+            return
+        try:
+            with self._write_lock:
+                self._path.parent.mkdir(parents=True, exist_ok=True)
+                with self._path.open("a") as f:
+                    f.write(json.dumps(rec) + "\n")
+        except OSError:
+            self._broken = True  # advisory: disk full must not kill training
+
+    def recent(self, n: int = 50) -> list[dict]:
+        """Most recent completed records, oldest first."""
+        items = list(self._ring)
+        return items[-n:]
+
+
+_global = SpanTracer()
+
+
+def get_tracer() -> SpanTracer:
+    """Process-wide tracer (memory ring only) for cross-cutting events:
+    chaos injections, executor lifecycle. Components that export to a
+    run's artifacts dir build their own `SpanTracer(path=...)`."""
+    return _global

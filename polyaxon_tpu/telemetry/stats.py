@@ -1,0 +1,68 @@
+"""Exact percentile math + the analytic train-step FLOPs formula.
+
+The ONE implementation of sample quantiles for the repo: benchmarks
+(`benchmarks/_timing.py`, `benchmarks/serving_bench.py`) and the
+registry's `/statsz` summaries used to each hand-roll their own (median
+here, `sorted[int(0.95*(n-1))]` there) — close enough to agree on large
+samples, different enough to diverge on the small ones CI runs.
+
+`train_step_flops` is the analytic transformer fwd+bwd cost shared by
+bench.py and the trainer's MFU gauge: 6·N FLOPs per token for the
+parameter matmuls plus the 12·L·d·s attention-score term. (XLA's
+cost_analysis would need a second full compile of the step — minutes of
+bench time for a number this formula gives within a few percent.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def quantile(values: Sequence[float], q: float) -> Optional[float]:
+    """Exact sample quantile with linear interpolation between order
+    statistics (numpy's default / type-7), q in [0, 1]. None on empty
+    input rather than raising — benchmark tails are often empty."""
+    if not values:
+        return None
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    s = sorted(float(v) for v in values)
+    pos = q * (len(s) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    frac = pos - lo
+    return s[lo] * (1.0 - frac) + s[hi] * frac
+
+
+def summarize(values: Sequence[float]) -> dict:
+    """count/mean/p50/p95/p99 of a sample — the benchmark reporting
+    shape."""
+    n = len(values)
+    return {
+        "count": n,
+        "mean": (sum(values) / n) if n else None,
+        "p50": quantile(values, 0.5),
+        "p95": quantile(values, 0.95),
+        "p99": quantile(values, 0.99),
+    }
+
+
+def train_step_flops(
+    n_params: int, n_layers: int, dim: int, seq_len: int, tokens: int
+) -> float:
+    """Analytic transformer train-step FLOPs for `tokens` tokens."""
+    return float(
+        (6 * n_params + 12 * n_layers * dim * seq_len) * tokens
+    )
+
+
+def mfu(flops_per_sec: float, device_kind: str, n_devices: int = 1) -> Optional[float]:
+    """Model FLOPs utilization against the device generation's peak bf16
+    throughput; None when the peak is unknown (CPU, unrecognized chip) —
+    MFU is then unreportable, not 0."""
+    from ..utils.tpu_info import peak_bf16_flops
+
+    peak = peak_bf16_flops(device_kind)
+    if not peak or flops_per_sec <= 0:
+        return None
+    return flops_per_sec / (peak * max(1, n_devices))

@@ -13,6 +13,16 @@ import time
 from typing import Optional
 
 from ..store.local import RunStore
+from ..telemetry import MetricsRegistry, get_registry
+
+
+def prime_cpu_percent() -> None:
+    """psutil.cpu_percent(interval=None) measures SINCE THE LAST CALL and
+    returns 0.0 on the first one — call this once before sampling starts
+    so the first real sample reflects actual load."""
+    import psutil
+
+    psutil.cpu_percent(interval=None)
 
 
 def host_metrics() -> dict[str, float]:
@@ -63,7 +73,12 @@ def device_metrics() -> dict[str, float]:
 class SystemMonitor:
     """Background sampler: `with SystemMonitor(store, run_uuid): ...` or
     explicit start()/stop(). Failures inside the loop never propagate into
-    training."""
+    training.
+
+    Samples go two places from one read: the run store (the per-run
+    history the CLI/streams surface) and a telemetry registry's gauges
+    (the live `/metricsz` view) — the unified pipeline, not a second
+    sampler."""
 
     def __init__(
         self,
@@ -71,6 +86,7 @@ class SystemMonitor:
         run_uuid: Optional[str] = None,
         interval: float = 10.0,
         include_devices: bool = True,
+        registry: Optional[MetricsRegistry] = None,
     ):
         import os
 
@@ -80,24 +96,36 @@ class SystemMonitor:
             raise ValueError("SystemMonitor needs a run uuid")
         self.interval = interval
         self.include_devices = include_devices
+        self.registry = registry or get_registry()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._samples = 0
 
+    def _sample_once(self):
+        metrics = host_metrics()
+        if self.include_devices:
+            metrics.update(device_metrics())
+        self.store.log_metrics(self.run_uuid, self._samples, metrics)
+        for name, val in metrics.items():
+            self.registry.gauge(name).set(val)
+        self._samples += 1
+
     def _loop(self):
         while not self._stop.is_set():
             try:
-                metrics = host_metrics()
-                if self.include_devices:
-                    metrics.update(device_metrics())
-                self.store.log_metrics(self.run_uuid, self._samples, metrics)
-                self._samples += 1
+                self._sample_once()
             except Exception:
                 pass
             self._stop.wait(self.interval)
 
     def start(self) -> "SystemMonitor":
         if self._thread is None:
+            try:
+                # first-sample fix: cpu_percent measures since the LAST
+                # call — unprimed, sample 0 would always report 0.0
+                prime_cpu_percent()
+            except Exception:
+                pass
             self._thread = threading.Thread(
                 target=self._loop, daemon=True, name="polyaxon-sysmon"
             )
@@ -109,6 +137,13 @@ class SystemMonitor:
         if self._thread is not None:
             self._thread.join(timeout=self.interval + 1)
             self._thread = None
+            try:
+                # final flush: the sample at teardown captures end-of-run
+                # state (peak-ish HBM, post-run host load) that the
+                # interval grid would otherwise miss
+                self._sample_once()
+            except Exception:
+                pass
 
     def __enter__(self):
         return self.start()

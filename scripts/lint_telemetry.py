@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Telemetry lint: exactly ONE metrics clock in the package.
+
+Every duration measurement in `polyaxon_tpu/` must go through
+`polyaxon_tpu.telemetry.now()` (or a span) so all latency numbers share
+one clock and land in one registry. This script fails CI when any module
+outside `polyaxon_tpu/telemetry/` calls `time.perf_counter` — the
+tell-tale of a hand-rolled timing loop growing a second metrics
+pipeline. `time.monotonic` stays allowed: the serving queue uses it for
+deadlines (scheduling, not metrics).
+
+Scope is the package only. Benchmarks, tests, and top-level scripts own
+their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
+are exempt.
+
+    python scripts/lint_telemetry.py        # exit 0 clean, 1 with hits
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+PATTERN = re.compile(r"\bperf_counter\b")
+
+
+def violations(repo_root: Path) -> list[str]:
+    pkg = repo_root / "polyaxon_tpu"
+    out = []
+    for py in sorted(pkg.rglob("*.py")):
+        rel = py.relative_to(repo_root)
+        if rel.parts[:2] == ("polyaxon_tpu", "telemetry"):
+            continue
+        for i, line in enumerate(py.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]
+            if PATTERN.search(code):
+                out.append(f"{rel}:{i}: {line.strip()}")
+    return out
+
+
+def main() -> int:
+    root = Path(__file__).resolve().parent.parent
+    hits = violations(root)
+    if hits:
+        print(
+            "telemetry lint: raw time.perf_counter outside "
+            "polyaxon_tpu/telemetry/ — route timing through "
+            "polyaxon_tpu.telemetry.now() / spans instead:",
+            file=sys.stderr,
+        )
+        for h in hits:
+            print(f"  {h}", file=sys.stderr)
+        return 1
+    print("telemetry lint: ok (one metrics clock)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,72 @@ while true; do
     timeout 1200 python benchmarks/decode_bench.py \
       > tpu_results/decode_tpu.json 2>> "$log"
     echo "decode rc=$? $(date -u +%T)" >> "$log"
+    # telemetry gate: after the smoke traffic, /metricsz must expose the
+    # required series — a capture whose metrics pipeline is dark is not
+    # usable perf evidence, so a missing series FAILS the canary.
+    echo "running metricsz smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+server = ModelServer(
+    b.module, params, config=ServingConfig(max_batch=4, max_wait_ms=10.0)
+)
+port = server.start(port=0)
+try:
+    body = {"tokens": [[1, 2, 3]], "maxNewTokens": 4,
+            "temperature": 0.5, "topK": 10, "seed": 0}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=300).read()
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+finally:
+    server.stop()
+with open("tpu_results/metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "serving_request_seconds_bucket",
+    "serving_requests_total",
+    "serving_compile_cache_hits_total",
+    "serving_compile_cache_misses_total",
+    "serving_queue_wait_seconds_bucket",
+    "serving_batch_occupancy_bucket",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("metricsz smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"metricsz smoke: ok ({len(required)} required series present)")
+PY
+    then
+      echo "METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
+    python scripts/lint_telemetry.py >> "$log" 2>&1 || {
+      echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    }
     touch tpu_results/COMPLETE
     (
       flock 9

@@ -13,7 +13,11 @@ from polyaxon_tpu.tracking.monitors import SystemMonitor, host_metrics
 
 def _get(port, path):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
-        return r.status, json.loads(r.read())
+        body = r.read()
+        # /metricsz is Prometheus text, everything else JSON
+        if "json" in (r.headers.get("Content-Type") or ""):
+            return r.status, json.loads(body)
+        return r.status, body.decode()
 
 
 def _seed_run(store, uuid="abc123def456"):
