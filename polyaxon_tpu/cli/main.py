@@ -168,6 +168,54 @@ def stats(run_ref, n_spans, n_events):
         raise click.ClickException(str(e.args[0]) if e.args else str(e))
     status = store.get_status(uuid)
     click.echo(f"run {uuid[:8]}  status={status.get('status', '?')}")
+    # scheduler view: where a pending run sits in its queue, and what the
+    # fleet has reserved (or not) for it
+    meta = status.get("meta") or {}
+    if status.get("status") in (V1Statuses.QUEUED, V1Statuses.SCHEDULED):
+        import time as _time
+
+        from ..scheduler.queue import RunQueue
+
+        qname = meta.get("queue") or "default"
+        entry = next(
+            (
+                e
+                for e in RunQueue(store, name=qname).peek_all()
+                if e["uuid"] == uuid
+            ),
+            None,
+        )
+        if entry is not None and entry.get("enqueued_at"):
+            wait = max(0.0, _time.time() - float(entry["enqueued_at"]))
+            click.echo(
+                f"queued on {qname!r} for {wait:.1f}s "
+                f"(priority {entry.get('priority', 0)}, "
+                f"seq {entry.get('seq', '?')}, "
+                f"chips {entry.get('chips', '?')})"
+            )
+    from ..scheduler.fleet import Fleet
+
+    _fleet = Fleet(store)
+    if _fleet.configured:
+        rec = _fleet.ledger.get(uuid)
+        if rec is not None:
+            click.echo(
+                f"reservation: {rec['chips']} chips"
+                + (
+                    " (block "
+                    + "x".join(str(b) for b in rec["block"])
+                    + ")"
+                    if rec.get("block")
+                    else ""
+                )
+            )
+        elif status.get("status") in (V1Statuses.QUEUED, V1Statuses.SCHEDULED):
+            click.echo("reservation: none yet (waiting for admission)")
+    if meta.get("preempt_restarts"):
+        click.echo(
+            f"scheduler preemptions: {meta['preempt_restarts']} "
+            "(resumed from checkpoint)"
+        )
     folded: dict = {}
     step = None
     for rec in store.read_metrics(uuid):
@@ -736,9 +784,18 @@ def queues():
 
 @queues.command("ls")
 def queues_ls():
+    """Queues with settings, backlog, and the current head-of-line wait."""
+    import time as _time
+
     from ..scheduler.queue import QueueRegistry
 
-    for row in QueueRegistry(RunStore()).stats():
+    registry = QueueRegistry(RunStore())
+    now = _time.time()
+    for row in registry.stats():
+        entries = registry.get(row["name"]).peek_all()
+        stamps = [e["enqueued_at"] for e in entries if e.get("enqueued_at")]
+        if stamps:
+            row["oldest_wait_s"] = round(max(0.0, now - min(stamps)), 1)
         click.echo(json.dumps(row))
 
 
@@ -753,6 +810,87 @@ def queues_set(name, concurrency, priority):
         name, concurrency=concurrency, priority=priority
     )
     click.echo(f"queue {name}: concurrency={concurrency} priority={priority}")
+
+
+@cli.group()
+def fleet():
+    """Device fleet: inventory, gang reservations, quotas.
+
+    With a configured fleet the agent admits runs through the scheduler
+    (chip reservations, quotas, priority preemption) instead of bare
+    queue concurrency. Unconfigured = everything behaves as before."""
+
+
+@fleet.command("init")
+@click.option("--topology", default=None,
+              help="ICI torus, e.g. 4x8 or 4x4x4 (reservations become "
+              "axis-aligned sub-blocks)")
+@click.option("--chips", default=None, type=int,
+              help="flat pool size; omit both to derive from jax.devices()")
+def fleet_init(topology, chips):
+    """Configure the fleet's capacity and enable scheduler admission."""
+    from ..scheduler.fleet import Fleet
+
+    try:
+        cfg = Fleet(RunStore()).configure(topology=topology, chips=chips)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"fleet configured: {json.dumps(cfg)}")
+
+
+@fleet.command("show")
+def fleet_show():
+    """Inventory, reservations, and per-project usage (the /fleetz body)."""
+    from ..scheduler.fleet import Fleet
+
+    click.echo(json.dumps(Fleet(RunStore()).snapshot(), indent=1))
+
+
+@fleet.group("quota")
+def fleet_quota():
+    """Per-project and per-queue admission quotas."""
+
+
+@fleet_quota.command("set")
+@click.argument("scope")
+@click.option("--max-chips", default=None, type=int,
+              help="cap on concurrently reserved chips")
+@click.option("--max-runs", default=None, type=int,
+              help="cap on concurrent admitted runs")
+@click.option("--weight", default=1.0, type=float,
+              help="fair-share weight at equal priority (higher = more)")
+def fleet_quota_set(scope, max_chips, max_runs, weight):
+    """SCOPE is a project name, or queue:<name> for a queue-wide quota."""
+    from ..schemas.quota import V1QuotaSpec
+    from ..scheduler.admission import QuotaManager
+
+    try:
+        spec = V1QuotaSpec(
+            scope=scope, max_chips=max_chips, max_runs=max_runs, weight=weight
+        )
+    except Exception as e:  # pydantic ValidationError → clean CLI error
+        raise click.ClickException(str(e))
+    QuotaManager(RunStore()).set(spec)
+    click.echo(f"quota {scope}: {json.dumps(spec.to_dict())}")
+
+
+@fleet_quota.command("ls")
+def fleet_quota_ls():
+    from ..scheduler.admission import QuotaManager
+
+    for spec in QuotaManager(RunStore()).all():
+        click.echo(json.dumps(spec.to_dict()))
+
+
+@fleet_quota.command("rm")
+@click.argument("scope")
+def fleet_quota_rm(scope):
+    from ..scheduler.admission import QuotaManager
+
+    if QuotaManager(RunStore()).remove(scope):
+        click.echo(f"quota {scope} removed")
+    else:
+        raise click.ClickException(f"no quota for scope {scope!r}")
 
 
 @cli.group()

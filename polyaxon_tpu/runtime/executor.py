@@ -91,7 +91,16 @@ class Executor:
         timeout = term.timeout if term else None
 
         attempt = 0  # budgeted retries consumed (transient failures)
-        restarts = 0  # all restarts, including free preemption restarts
+        # all restarts, including free preemption restarts. Seeded from
+        # meta: a run evicted by the scheduler (checkpoint-and-requeue)
+        # arrives back here as a fresh execute() call — preempt_restarts
+        # carries the count across, so resume=restarts>0 restores the
+        # checkpoint instead of restarting from step 0.
+        restarts = int(
+            (store.get_status(run_uuid).get("meta") or {}).get(
+                "preempt_restarts", 0
+            )
+        )
         while True:
             if self._stopped(run_uuid):  # stop landed between attempts
                 return V1Statuses.STOPPED
@@ -117,6 +126,13 @@ class Executor:
 
                 kind = classify(e)
                 if kind == PREEMPTED:
+                    # scheduler eviction rides the same machinery as machine
+                    # preemption (flag → boundary checkpoint → Preempted),
+                    # but the chips are wanted by someone else: yield them
+                    # and go back to the queue instead of restarting here.
+                    meta = store.get_status(run_uuid).get("meta") or {}
+                    if meta.get("preempt_requested"):
+                        return self._requeue_preempted(compiled, e, restarts)
                     # the program was healthy; the machine went away. Restart
                     # from checkpoint WITHOUT burning the retry budget.
                     restarts += 1
@@ -168,6 +184,50 @@ class Executor:
                 )
                 self._run_hooks(compiled, V1Statuses.FAILED)
                 return V1Statuses.FAILED
+
+    def _requeue_preempted(
+        self, compiled: CompiledOperation, exc: BaseException, restarts: int
+    ) -> str:
+        """Scheduler-initiated eviction: the admission controller flagged
+        this run to yield its chips to a higher-priority gang, the trainer
+        flushed a checkpoint at the step boundary and raised Preempted.
+        Release the reservation, re-enqueue at the ORIGINAL priority, and
+        let a later admission pass restart it (resume comes free because
+        preempt_restarts makes the next execute() pass resume=True)."""
+        store, run_uuid = self.store, compiled.run_uuid
+        meta = store.get_status(run_uuid).get("meta") or {}
+        store.set_meta(
+            run_uuid, preempt_requested=False, preempt_restarts=restarts + 1
+        )
+        store.log_event(
+            run_uuid,
+            "preempted",
+            {
+                "step": getattr(exc, "step", None),
+                "restart": restarts + 1,
+                "scheduler": True,
+            },
+        )
+        store.set_status(
+            run_uuid,
+            V1Statuses.RETRYING,
+            reason="evicted",
+            message=str(exc),
+        )
+        store.set_status(run_uuid, V1Statuses.QUEUED)
+        from ..scheduler.fleet import Fleet
+        from ..scheduler.queue import RunQueue
+
+        Fleet(store).release(run_uuid)  # chips go to the preemptor
+        RunQueue(store, name=meta.get("queue") or "default").push(
+            run_uuid,
+            {
+                "operation": compiled.operation.to_dict(),
+                "project": compiled.project,
+            },
+            priority=int(meta.get("priority", 0)),
+        )
+        return V1Statuses.QUEUED
 
     def _stopped(self, run_uuid: str) -> bool:
         """True when a stop request landed; settles STOPPING → STOPPED."""
@@ -595,9 +655,15 @@ class Executor:
             )
             store.append_log(run_uuid, line)
             # log points are the cooperative cancellation boundary
-            status = store.get_status(run_uuid).get("status")
+            data = store.get_status(run_uuid)
+            status = data.get("status")
             if status in (V1Statuses.STOPPING, V1Statuses.STOPPED):
                 raise StopRequested(f"stop requested at step {step}")
+            # scheduler eviction rides the SIGTERM machinery: raise the
+            # preemption flag and the trainer checkpoints at the next step
+            # boundary before raising Preempted
+            if (data.get("meta") or {}).get("preempt_requested"):
+                preemption.trigger()
 
         trainer = Trainer(
             program,

@@ -54,6 +54,12 @@ class Agent:
         self.cluster = cluster if cluster is not None else getattr(
             submit_fn, "cluster", None
         )
+        from .admission import AdmissionController
+
+        # admission replaces bare concurrency gating when a fleet is
+        # configured (`polyaxon fleet init`); inactive otherwise, so
+        # single-box workflows keep the original pop-based claiming
+        self.admission = AdmissionController(self.store)
 
     def submit(
         self,
@@ -93,6 +99,8 @@ class Agent:
             meta={
                 "fingerprint": spec_fingerprint(compiled),
                 "queue": routed_queue.name,
+                # original priority: a preempted run re-enqueues with it
+                "priority": int(priority),
                 **(meta or {}),
             },
         )
@@ -100,10 +108,17 @@ class Agent:
             prepare_fn(compiled)
         self.store.set_status(compiled.run_uuid, V1Statuses.COMPILED)
         self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
+        # chip demand is stamped on the queue entry at submit time so the
+        # admission controller never has to re-compile specs while scanning
+        from .fleet import chips_demand, topology_request
+
+        block = topology_request(compiled.operation)
         routed_queue.push(
             compiled.run_uuid,
             {"operation": compiled.operation.to_dict(), "project": compiled.project},
             priority=priority,
+            chips=chips_demand(compiled.operation),
+            block=list(block) if block else None,
         )
         return compiled.run_uuid
 
@@ -182,10 +197,10 @@ class Agent:
         return [(self.registry.get(n), self.registry.settings(n, cfg)) for n in names]
 
     def _safe_process(self, entry: dict) -> None:
+        uid = entry.get("uuid")
         try:
             self._process(entry)
         except Exception as e:  # noqa: BLE001 — record on the run, keep draining
-            uid = entry.get("uuid")
             try:
                 self.store.append_log(uid, f"agent: {type(e).__name__}: {e}")
                 self.store.set_status(
@@ -193,6 +208,59 @@ class Agent:
                 )
             except Exception:
                 pass
+        finally:
+            # safety net: the store releases reservations on terminal
+            # transitions, but a run deleted mid-queue (or settled before
+            # this agent claimed it) never transitions — drop its chips here
+            if self.admission.active:
+                from ..schemas.lifecycle import DONE_STATUSES
+
+                status = self.store.get_status(uid).get("status")
+                if not status or status in DONE_STATUSES:
+                    self.admission.fleet.release(uid)
+
+    def _claim(self, q: RunQueue, take: int) -> list[dict]:
+        """Claim up to `take` entries from one queue. Without a configured
+        fleet this is a plain pop (the original concurrency-only gating).
+        With one, every claim passes admission: quota check, all-or-nothing
+        gang reservation, UNSCHEDULABLE rejection of can-never-fit runs,
+        backfill past blocked gangs, and preemption requests on behalf of
+        higher-priority arrivals."""
+        from .admission import ADMIT, REJECT
+
+        if not self.admission.active:
+            batch = []
+            for _ in range(take):
+                entry = q.pop()
+                if entry is None:
+                    break
+                batch.append(entry)
+            return batch
+        batch: list[dict] = []
+        for entry in self.admission.order(q.peek_all()):
+            if len(batch) >= take:
+                break
+            decision = self.admission.try_admit(entry, queue_name=q.name)
+            if decision.outcome == ADMIT:
+                if not q.remove(entry["uuid"]):
+                    # lost the claim race to another agent: give chips back
+                    self.admission.fleet.release(entry["uuid"])
+                    continue
+                self.admission.observe_queue_wait(entry)
+                batch.append(entry)
+            elif decision.outcome == REJECT:
+                q.remove(entry["uuid"])
+                try:
+                    self.store.set_status(
+                        entry["uuid"],
+                        V1Statuses.UNSCHEDULABLE,
+                        reason="AdmissionRejected",
+                        message=decision.reason,
+                    )
+                except (ValueError, OSError, KeyError):
+                    pass  # deleted/settled elsewhere; the entry is gone
+            # WAIT: stays queued — later entries may backfill around it
+        return batch
 
     def drain(self, max_runs: Optional[int] = None) -> int:
         """Process queued runs until every watched queue is empty (or
@@ -210,12 +278,7 @@ class Agent:
                     continue  # concurrency 0 = paused queue
                 budget = (max_runs - count) if max_runs is not None else None
                 take = conc if budget is None else max(1, min(conc, budget))
-                batch = []
-                for _ in range(take):
-                    entry = q.pop()
-                    if entry is None:
-                        break
-                    batch.append(entry)
+                batch = self._claim(q, take)
                 if not batch:
                     continue
                 progressed = True

@@ -4,10 +4,18 @@ Reference parity (SURVEY.md §2 "Control plane": queues feed the agent).
 File-backed (one JSON line per entry, POSIX lock around mutations) so a
 CLI submit in one process and an agent in another see the same queue —
 the local stand-in for upstream's DB-backed queues.
+
+Ordering: entries are kept sorted by `(-priority, seq)` where `seq` is a
+monotonic per-queue counter persisted in a sidecar file. Pushes use
+`bisect.insort` against that key instead of re-sorting the whole file,
+and FIFO-within-priority survives remove/re-add cycles — a run popped
+and re-enqueued (e.g. after preemption) keeps ordering by its NEW seq,
+while untouched entries never shuffle relative to each other.
 """
 
 from __future__ import annotations
 
+import bisect
 import fcntl
 import json
 import os
@@ -17,11 +25,16 @@ from typing import Any, Optional
 from ..store.local import RunStore
 
 
+def _order(entry: dict) -> tuple[int, int]:
+    return (-int(entry.get("priority", 0)), int(entry.get("seq", 0)))
+
+
 class RunQueue:
     def __init__(self, store: Optional[RunStore] = None, name: str = "default"):
         self.store = store or RunStore()
         self.name = name
         self.path = Path(self.store.home) / "queues" / f"{name}.jsonl"
+        self.seq_path = self.path.with_suffix(".seq")
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.path.touch(exist_ok=True)
 
@@ -35,19 +48,55 @@ class RunQueue:
                 f.truncate()
                 for e in entries:
                     f.write(json.dumps(e) + "\n")
+                # flush BEFORE releasing the lock: Python buffers writes
+                # and flushes at close, which happens after the unlock —
+                # a concurrent reader would see the pre-mutation file and
+                # silently drop this update
+                f.flush()
+                os.fsync(f.fileno())
                 return result
             finally:
                 fcntl.flock(f, fcntl.LOCK_UN)
 
-    def push(self, run_uuid: str, payload: dict[str, Any], priority: int = 0):
-        def fn(entries):
-            entries.append(
-                {"uuid": run_uuid, "priority": priority, "payload": payload}
-            )
-            entries.sort(key=lambda e: -e.get("priority", 0))
-            return None, entries
+    def _next_seq(self) -> int:
+        """Monotonic per-queue counter. Only called under the queue file
+        lock, so the read-increment-write is race-free; persisted in a
+        sidecar (not max(seq in file) — popped entries must not recycle
+        their slot, or re-added runs would jump the FIFO line)."""
+        try:
+            current = int(self.seq_path.read_text())
+        except (OSError, ValueError):
+            current = 0
+        self.seq_path.write_text(str(current + 1))
+        return current + 1
 
-        self._locked(fn)
+    def push(
+        self,
+        run_uuid: str,
+        payload: dict[str, Any],
+        priority: int = 0,
+        **extra: Any,
+    ) -> dict:
+        """Enqueue; returns the stored entry. `extra` rides along in the
+        entry (the agent stamps `chips`/`block` demand and `enqueued_at`
+        for the admission controller)."""
+
+        def fn(entries):
+            entry = {
+                "uuid": run_uuid,
+                "priority": int(priority),
+                "seq": self._next_seq(),
+                **extra,
+                "payload": payload,
+            }
+            if "enqueued_at" not in entry:
+                from .clock import WALL
+
+                entry["enqueued_at"] = WALL.time()
+            bisect.insort(entries, entry, key=_order)
+            return entry, entries
+
+        return self._locked(fn)
 
     def pop(self) -> Optional[dict]:
         """Claim the highest-priority entry (None if empty)."""

@@ -44,6 +44,7 @@ from .matrix import (
     parse_matrix,
 )
 from .operation import V1Hook, V1Join, V1Operation, V1Schedule
+from .quota import V1QuotaSpec
 from .run_kinds import (
     RUN_KINDS,
     V1Container,

@@ -53,6 +53,13 @@ class V1TpuSpec(BaseSchema):
             raise ValueError(f"slices must be >= 1, got {v}")
         return v
 
+    @field_validator("count")
+    @classmethod
+    def _check_count(cls, v: Optional[int]) -> Optional[int]:
+        if v is not None and v < 1:
+            raise ValueError(f"count must be >= 1, got {v}")
+        return v
+
     @field_validator("type")
     @classmethod
     def _check_type(cls, v: str) -> str:
@@ -120,14 +127,27 @@ class V1Resources(BaseSchema):
     """Resources block. `tpu:` is the TPU-native extension; cpu/memory/gpu kept
     for compatibility with stock Polyaxonfiles (gpu requests are rejected at
     compile time by the TPU converter with a migration hint, not at parse
-    time, so `polyaxon check` can still lint legacy files)."""
+    time, so `polyaxon check` can still lint legacy files).
+
+    `chips:` is a plain accelerator-count request for the fleet scheduler
+    (scheduler/admission.py) when a run doesn't pin an ICI topology — any
+    N free chips satisfy it. A `tpu:` block implies its own chip demand
+    (`total_chips`) and wins over `chips`."""
 
     cpu: Optional[float | int | str] = None
     memory: Optional[str | int] = None
     gpu: Optional[int] = None
+    chips: Optional[int] = None
     tpu: Optional[V1TpuSpec] = None
     limits: Optional[dict[str, float | int | str]] = None
     requests: Optional[dict[str, float | int | str]] = None
+
+    @field_validator("chips")
+    @classmethod
+    def _check_chips(cls, v: Optional[int]) -> Optional[int]:
+        if v is not None and v < 1:
+            raise ValueError(f"chips must be >= 1, got {v}")
+        return v
 
 
 class V1Environment(BaseSchema):

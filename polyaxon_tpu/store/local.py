@@ -118,6 +118,23 @@ class RunStore:
             "runs.transitions", help="Run status transitions, all statuses"
         ).inc()
         reg.counter(f"runs.transitions.{V1Statuses(status).value}").inc()
+        # chips never outlive the lifecycle: EVERY terminal transition —
+        # succeeded, failed, stopped, skipped — drops the run's gang
+        # reservation, whichever process drove the run there
+        if is_done(V1Statuses(status)):
+            self._release_reservation(run_uuid)
+
+    def _release_reservation(self, run_uuid: str) -> None:
+        """Drop the run's fleet reservation, if any. Guarded on the ledger
+        file so stores without a configured fleet pay no import or lock."""
+        if not (self.home / "fleet" / "reservations.json").exists():
+            return
+        from ..scheduler.fleet import Fleet
+
+        try:
+            Fleet(self).release(run_uuid)
+        except Exception:  # noqa: BLE001
+            pass  # a release failure must never block a status transition
 
     def get_status(self, run_uuid: str) -> dict:
         return _read_json(self.run_dir(run_uuid) / "status.json") or {}

@@ -72,6 +72,73 @@ def spec() -> dict:
                     },
                 }
             },
+            "/fleetz": {
+                "get": {
+                    "summary": "Fleet snapshot: inventory, reservations, "
+                    "quota usage",
+                    "responses": {
+                        "200": {
+                            "description": "fleet state",
+                            "content": {
+                                "application/json": {
+                                    "schema": {
+                                        "type": "object",
+                                        "properties": {
+                                            "configured": {"type": "boolean"},
+                                            "config": {
+                                                "type": "object",
+                                                "nullable": True,
+                                                "description": "topology or "
+                                                "flat chip count, as set by "
+                                                "`polyaxon fleet init`",
+                                            },
+                                            "chips_total": {"type": "integer"},
+                                            "chips_reserved": {
+                                                "type": "integer"
+                                            },
+                                            "chips_free": {"type": "integer"},
+                                            "reservations": {
+                                                "type": "array",
+                                                "description": "gang "
+                                                "reservations, oldest first",
+                                                "items": {
+                                                    "type": "object",
+                                                    "properties": {
+                                                        "uuid": {
+                                                            "type": "string"
+                                                        },
+                                                        "chips": {
+                                                            "type": "integer"
+                                                        },
+                                                        "project": {
+                                                            "type": "string"
+                                                        },
+                                                        "queue": {
+                                                            "type": "string"
+                                                        },
+                                                        "priority": {
+                                                            "type": "integer"
+                                                        },
+                                                        "reserved_at": {
+                                                            "type": "number"
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                            "projects": {
+                                                "type": "object",
+                                                "description": "per-project "
+                                                "{chips, runs, quota}",
+                                                "additionalProperties": True,
+                                            },
+                                        },
+                                    }
+                                }
+                            },
+                        }
+                    },
+                }
+            },
             "/runs": {
                 "get": {
                     "summary": "List runs",

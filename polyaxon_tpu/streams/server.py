@@ -104,6 +104,13 @@ class _Handler(BaseHTTPRequestHandler):
                 from .openapi import spec as openapi_spec
 
                 return self._send(200, _json_bytes(openapi_spec()))
+            if parts == ["fleetz"]:
+                # fleet snapshot: inventory, gang reservations, per-project
+                # usage vs quota (scheduler/fleet.py). Works unconfigured
+                # too — `configured: false` with zeroed capacity.
+                from ..scheduler.fleet import Fleet
+
+                return self._send(200, _json_bytes(Fleet(store).snapshot()))
             if parts == ["runs"]:
                 return self._send(
                     200, _json_bytes(store.list_runs(query.get("project")))

@@ -8,70 +8,25 @@ environment), trials get TRUE sub-grids: block shapes whose dims divide the
 torus dims, so every trial's collectives stay on its own ICI neighborhood
 and never cross another trial's wires. Without a topology, we fall back to
 contiguous equal splits of the `mesh_utils`-ordered device list (order
-follows physical coords, preserving locality)."""
+follows physical coords, preserving locality).
+
+The block math itself (parse/choose/tile) is shared with the fleet
+inventory — one implementation in scheduler/topology.py."""
 
 from __future__ import annotations
 
-import itertools
 from typing import Optional, Sequence
 
 import jax
 
-
-def parse_topology(spec) -> Optional[tuple[int, ...]]:
-    """V1TpuSpec (or its `topology` string) → dim tuple, else None —
-    including malformed strings (callers fall back to list-order splits)."""
-    topo = getattr(spec, "topology", spec)
-    if not topo or not isinstance(topo, str):
-        return None
-    parts = topo.lower().split("x")
-    if not all(p.isdigit() and int(p) > 0 for p in parts):
-        return None
-    return tuple(int(p) for p in parts)
-
-
-def _divisors(n: int) -> list[int]:
-    return [d for d in range(1, n + 1) if n % d == 0]
-
-
-def choose_block_shape(
-    topology: Sequence[int], n_trials: int
-) -> tuple[int, ...]:
-    """Largest legal sub-grid shape that yields >= n_trials disjoint tiles.
-
-    Legal = every block dim divides its torus dim (blocks tile the torus).
-    Among shapes with the minimal sufficient tile count, prefer the most
-    balanced block (smallest max/min dim ratio) — balanced sub-tori have
-    the best bisection bandwidth for a trial's own collectives."""
-    if n_trials <= 0:
-        raise ValueError("n_trials must be positive")
-    best = None
-    for shape in itertools.product(*[_divisors(t) for t in topology]):
-        tiles = 1
-        for t, s in zip(topology, shape):
-            tiles *= t // s
-        if tiles < n_trials:
-            continue
-        balance = max(shape) / max(1, min(shape))
-        key = (tiles, balance, -min(shape))
-        if best is None or key < best[0]:
-            best = (key, shape)
-    if best is None:  # n_trials > chip count: every trial gets one chip
-        return tuple(1 for _ in topology)
-    return best[1]
-
-
-def _grid_blocks(topology: Sequence[int], block: Sequence[int]) -> list[list[tuple]]:
-    """Coordinate blocks tiling the torus, lexicographic tile order."""
-    ranges = [range(0, t, s) for t, s in zip(topology, block)]
-    blocks = []
-    for origin in itertools.product(*ranges):
-        coords = [
-            tuple(o + d for o, d in zip(origin, delta))
-            for delta in itertools.product(*[range(s) for s in block])
-        ]
-        blocks.append(coords)
-    return blocks
+# Re-exported: existing callers (tuner/driver.py, tests) import these from
+# here; the single implementation lives in scheduler/topology.py, shared
+# with the fleet scheduler's DeviceInventory.
+from ..scheduler.topology import (  # noqa: F401
+    choose_block_shape,
+    grid_blocks as _grid_blocks,
+    parse_topology,
+)
 
 
 def sub_slices(

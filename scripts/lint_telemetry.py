@@ -9,6 +9,14 @@ tell-tale of a hand-rolled timing loop growing a second metrics
 pipeline. `time.monotonic` stays allowed: the serving queue uses it for
 deadlines (scheduling, not metrics).
 
+Second rule, same spirit: exactly ONE scheduling clock in the fleet
+scheduler. Everything under `polyaxon_tpu/scheduler/` must take time
+from an injected `Clock` (`polyaxon_tpu/scheduler/clock.py`) so the
+simulator/benchmark can replace it with `SimClock` and replay a workload
+deterministically. A raw `time.time()`/`time.monotonic()` there would be
+invisible to the simulated clock and silently skew queue-wait math, so
+both are forbidden outside `scheduler/clock.py`.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -23,6 +31,7 @@ import sys
 from pathlib import Path
 
 PATTERN = re.compile(r"\bperf_counter\b")
+SCHED_PATTERN = re.compile(r"\btime\.(?:time|monotonic)\s*\(")
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -32,10 +41,19 @@ def violations(repo_root: Path) -> list[str]:
         rel = py.relative_to(repo_root)
         if rel.parts[:2] == ("polyaxon_tpu", "telemetry"):
             continue
+        in_scheduler = rel.parts[:2] == ("polyaxon_tpu", "scheduler")
+        clock_exempt = in_scheduler and rel.name == "clock.py"
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
                 out.append(f"{rel}:{i}: {line.strip()}")
+            if in_scheduler and not clock_exempt and SCHED_PATTERN.search(
+                code
+            ):
+                out.append(
+                    f"{rel}:{i}: raw wall clock in scheduler/ "
+                    f"(use scheduler.clock.Clock): {line.strip()}"
+                )
     return out
 
 

@@ -103,6 +103,74 @@ PY
       echo "METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # fleet scheduler gate: drive a deterministic admission scenario
+    # (fill fleet -> high-priority preemption -> over-quota rejection)
+    # through the REAL simulator and require the fleet.*/scheduler.*
+    # series on /metricsz. A scheduler whose telemetry is dark would
+    # ship blind capacity decisions, so a missing series FAILS the run.
+    echo "running fleet metricsz smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+from polyaxon_tpu.schemas import V1QuotaSpec
+from polyaxon_tpu.scheduler.sim import FleetSimulator, SimJob
+from polyaxon_tpu.streams.server import make_server
+
+jobs = [
+    # fills the 2x2 fleet, then gets evicted by the priority-10 arrival
+    SimJob(name="wide", duration=100, arrival=0, chips=4, project="alpha"),
+    SimJob(name="hot", duration=20, arrival=10, chips=2, priority=10,
+           project="alpha"),
+    # capped at 2 chips -> asking 4 can NEVER fit -> admission.rejected
+    SimJob(name="greedy", duration=5, arrival=5, chips=4, project="capped"),
+]
+sim = FleetSimulator(
+    jobs,
+    topology="2x2",
+    quotas=[V1QuotaSpec(scope="capped", max_chips=2)],
+    invariant_fn=lambda s: s.check_invariants(),
+)
+report = sim.run()
+assert report["preemptions"] >= 1, report
+assert report["unschedulable"] == 1, report
+
+server = make_server(sim.store, port=0)
+port = server.server_address[1]
+import threading
+
+threading.Thread(target=server.serve_forever, daemon=True).start()
+try:
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    fleetz = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/fleetz", timeout=30
+    ).read().decode()
+finally:
+    server.shutdown()
+with open("tpu_results/fleet_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+with open("tpu_results/fleetz_tpu.json", "w") as f:
+    f.write(fleetz)
+required = (
+    "fleet_chips_total",
+    "fleet_chips_reserved",
+    "scheduler_queue_wait_ms_bucket",
+    "scheduler_preemptions_total",
+    "admission_rejected_total",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("fleet metricsz smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"fleet metricsz smoke: ok ({len(required)} required series present)")
+PY
+    then
+      echo "FLEET-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
