@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import os
 import signal
+import time
 from pathlib import Path
 from typing import Optional
 
@@ -91,6 +92,10 @@ def _perform(fault: Fault, point: str, ctx: dict) -> None:
         raise SimulatedKill(fault.message)
     if fault.action == "sigterm":
         os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if fault.action == "sleep":
+        # brownout: stall the instrumented site (decode latency injection)
+        time.sleep(max(0.0, fault.delay_ms) / 1e3)
         return
     if fault.action == "corrupt_checkpoint":
         mgr = ctx.get("manager")

@@ -26,9 +26,12 @@ class Fault:
     point:   injection-point name the fault is bound to.
     action:  what to do when it fires — "raise" (TransientError),
              "raise_permanent" (PermanentError), "kill" (simulated
-             SIGKILL: a mid-step process death), "sigterm" (real SIGTERM
-             to this process — the preemption grace notice), or
-             "corrupt_checkpoint" (scramble the just-written step).
+             SIGKILL: a mid-step process death — at a `serving.worker`
+             point this takes the decode worker thread down),
+             "sigterm" (real SIGTERM to this process — the preemption
+             grace notice), "corrupt_checkpoint" (scramble the
+             just-written step), or "sleep" (stall the instrumented
+             site `delay_ms` — brownout/deadline pressure).
     at:      fire on the Nth hit of the point (0-based), when `step` is
              not used for matching.
     count:   how many times the fault fires before it is spent. A spent
@@ -37,6 +40,7 @@ class Fault:
     step:    when set, fire on the hit whose ctx carries this step value
              (trainer-loop faults address steps, not call counts).
     message: text carried by raised errors (shows up in run logs).
+    delay_ms: stall duration for the "sleep" action.
     """
 
     point: str
@@ -45,9 +49,13 @@ class Fault:
     count: int = 1
     step: Optional[int] = None
     message: str = "chaos: injected fault"
+    delay_ms: float = 50.0
+    # fires already consumed — the hit window [at, at+count) is computed
+    # from the ORIGINAL count, so a count=3 outage really fires 3 times
+    fired: int = 0
 
     def _due(self, hit_index: int, ctx: dict) -> bool:
-        if self.count <= 0:
+        if self.fired >= self.count:
             return False
         if self.step is not None:
             return ctx.get("step") == self.step
@@ -80,7 +88,7 @@ class FaultPlan:
         self._hits[point] = i + 1
         for fault in self.faults:
             if fault.point == point and fault._due(i, ctx):
-                fault.count -= 1
+                fault.fired += 1
                 return fault
         return None
 
@@ -131,4 +139,76 @@ class FaultPlan:
             seed=seed,
             params={"corrupt_step": c, "kill_step": k,
                     "fallback_step": c - checkpoint_every},
+        )
+
+    # ------------------------------------------- serving-path scenarios
+    # The traffic-facing points (ISSUE 5): `serving.decode` fires per
+    # dispatched decode batch inside ModelServer._execute_group,
+    # `serving.slow` right before it (latency injection), and
+    # `serving.worker` per batch inside the DecodeCoalescer loop where a
+    # "kill" takes the worker thread itself down.
+
+    @classmethod
+    def serving_flaky_decode(
+        cls, seed: int, window: int, fails: int = 3
+    ) -> "FaultPlan":
+        """`fails` decode batches, seed-chosen in [0, window), each fail
+        with a transient error — scattered failures the breaker should
+        ride out without tripping (they are not consecutive unless the
+        seed says so)."""
+        rng = random.Random(f"serving_flaky_decode:{seed}")
+        hits = sorted(rng.sample(range(window), min(fails, window)))
+        return cls(
+            [Fault("serving.decode", "raise", at=h,
+                   message=f"chaos: decode failure at batch {h}")
+             for h in hits],
+            seed=seed,
+            params={"fail_hits": hits},
+        )
+
+    @classmethod
+    def serving_decode_outage(
+        cls, seed: int, window: int, fails: int
+    ) -> "FaultPlan":
+        """A contiguous decode outage: `fails` CONSECUTIVE batches fail
+        starting at a seed-chosen index — deterministic circuit-breaker
+        trip material (trips when fails >= breaker_threshold)."""
+        rng = random.Random(f"serving_decode_outage:{seed}")
+        start = rng.randrange(0, max(1, window - fails + 1))
+        return cls(
+            [Fault("serving.decode", "raise", at=start, count=fails,
+                   message="chaos: decode outage")],
+            seed=seed,
+            params={"outage_start": start, "outage_len": fails},
+        )
+
+    @classmethod
+    def serving_worker_crash(cls, seed: int, window: int) -> "FaultPlan":
+        """The decode worker thread dies with a seed-chosen batch in
+        flight — the watchdog must fail the group fast and restart."""
+        rng = random.Random(f"serving_worker_crash:{seed}")
+        k = rng.randrange(0, window)
+        return cls(
+            [Fault("serving.worker", "kill", at=k,
+                   message=f"chaos: worker killed at batch {k}")],
+            seed=seed,
+            params={"crash_hit": k},
+        )
+
+    @classmethod
+    def serving_brownout(
+        cls, seed: int, window: int, slow: int = 2, delay_ms: float = 50.0
+    ) -> "FaultPlan":
+        """`slow` consecutive decode batches stall `delay_ms` each,
+        starting at a seed-chosen index — deadline pressure without
+        failures (queued requests behind the stall should be dropped
+        before dispatch, not decoded late)."""
+        rng = random.Random(f"serving_brownout:{seed}")
+        start = rng.randrange(0, max(1, window - slow + 1))
+        return cls(
+            [Fault("serving.slow", "sleep", at=start, count=slow,
+                   delay_ms=delay_ms, message="chaos: slow decode")],
+            seed=seed,
+            params={"slow_start": start, "slow_len": slow,
+                    "delay_ms": delay_ms},
         )

@@ -617,11 +617,27 @@ def agent_drain(queues):
 @click.option("--no-batching", is_flag=True,
               help="disable bucketing+coalescing: one exact-shape compile "
                    "per request signature (debug/baseline mode)")
-def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching):
+@click.option("--max-queue", default=None, type=int,
+              help="admission bound: shed (503) when this many requests "
+                   "are queued or in flight (default 64)")
+@click.option("--default-deadline-ms", default=None, type=float,
+              help="deadline budget applied to requests that carry no "
+                   "deadlineMs of their own (default: none)")
+@click.option("--drain-grace-s", default=None, type=float,
+              help="on SIGTERM/stop, finish in-flight work for up to this "
+                   "many seconds before failing the rest (default 5.0)")
+@click.option("--breaker-threshold", default=None, type=int,
+              help="consecutive decode failures that trip the circuit "
+                   "breaker (default 5)")
+@click.option("--expected-devices", default=None, type=int,
+              help="wire slice health into /readyz: report not-ready when "
+                   "fewer than N devices respond")
+def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
+          max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
+          expected_devices):
     """Serve a checkpointed LM run's generation over HTTP
-    (GET /healthz, GET /statsz, POST /generate)."""
+    (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
-    from ..serving.batching import ServingConfig
     from ..serving.server import ServingError
 
     mesh_axes = None
@@ -635,29 +651,34 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching):
             raise click.ClickException(
                 f"--mesh expects axis=N[,axis=N...], got {mesh!r}"
             )
-    # only build an override config when a flag was given — otherwise the
-    # run spec's own `serving:` section (if any) supplies the defaults
-    config = None
-    if any(v is not None for v in (max_batch, max_wait_ms, buckets)) or no_batching:
+    # pass only the flags actually given: they layer over the run spec's
+    # own `serving:` section (if any), which supplies every other knob
+    overrides = {}
+    if buckets:
         try:
-            ladder = (
-                tuple(int(b) for b in buckets.split(",")) if buckets else None
+            overrides["prompt_buckets"] = tuple(
+                int(b) for b in buckets.split(",")
             )
         except ValueError:
             raise click.ClickException(
                 f"--buckets expects N,N,... ints, got {buckets!r}"
             )
-        defaults = ServingConfig()
-        config = ServingConfig(
-            max_batch=max_batch if max_batch is not None else defaults.max_batch,
-            max_wait_ms=(
-                max_wait_ms if max_wait_ms is not None else defaults.max_wait_ms
-            ),
-            prompt_buckets=ladder,
-            batching=not no_batching,
-        )
+    if no_batching:
+        overrides["batching"] = False
+    for field, value in (
+        ("max_batch", max_batch),
+        ("max_wait_ms", max_wait_ms),
+        ("max_queue", max_queue),
+        ("default_deadline_ms", default_deadline_ms),
+        ("drain_grace_s", drain_grace_s),
+        ("breaker_threshold", breaker_threshold),
+    ):
+        if value is not None:
+            overrides[field] = value
     try:
-        server = ModelServer.from_run(uid, mesh_axes=mesh_axes, config=config)
+        server = ModelServer.from_run(uid, mesh_axes=mesh_axes,
+                                      config_overrides=overrides or None,
+                                      expected_devices=expected_devices)
     except (ServingError, KeyError, ValueError) as e:
         # ValueError: mesh-vs-device/model mismatch from the mesh builder
         raise click.ClickException(str(e.args[0]) if e.args else str(e))
@@ -671,7 +692,7 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching):
     click.echo(
         f"serving {server.model_name} (step {server.step}) "
         f"on http://{host}:{bound} [{mode}] — "
-        "POST /generate, GET /healthz, GET /statsz"
+        "POST /generate, GET /healthz, GET /readyz, GET /statsz"
     )
     import signal
     import threading
@@ -682,6 +703,9 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching):
     try:
         stop.wait()
     finally:
+        # graceful drain: /readyz flips to 503 and admission closes
+        # immediately; in-flight work gets drain_grace_s to finish
+        click.echo("draining...")
         server.stop()
 
 

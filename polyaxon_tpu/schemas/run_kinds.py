@@ -134,11 +134,35 @@ class V1ServingSpec(BaseSchema):
     prompt_buckets: Optional[list[int]] = None
     max_new_buckets: Optional[list[int]] = None
     request_timeout_s: float | str = 600.0
+    # resilience (ISSUE 5): admission bound, deadline budget applied to
+    # requests that carry none, drain window on SIGTERM/stop, and the
+    # consecutive-decode-failure count that trips the circuit breaker
+    max_queue: int | str = 64
+    default_deadline_ms: Optional[float | str] = None
+    drain_grace_s: float | str = 5.0
+    breaker_threshold: int | str = 5
 
     @model_validator(mode="after")
     def _check(self):
         if isinstance(self.max_batch, int) and self.max_batch < 1:
             raise ValueError(f"maxBatch must be >= 1, got {self.max_batch}")
+        if isinstance(self.max_queue, int) and self.max_queue < 1:
+            raise ValueError(f"maxQueue must be >= 1, got {self.max_queue}")
+        if isinstance(self.breaker_threshold, int) and self.breaker_threshold < 1:
+            raise ValueError(
+                f"breakerThreshold must be >= 1, got {self.breaker_threshold}"
+            )
+        if (
+            isinstance(self.default_deadline_ms, (int, float))
+            and self.default_deadline_ms <= 0
+        ):
+            raise ValueError(
+                f"defaultDeadlineMs must be > 0, got {self.default_deadline_ms}"
+            )
+        if isinstance(self.drain_grace_s, (int, float)) and self.drain_grace_s < 0:
+            raise ValueError(
+                f"drainGraceS must be >= 0, got {self.drain_grace_s}"
+            )
         for name in ("prompt_buckets", "max_new_buckets"):
             ladder = getattr(self, name)
             if ladder is not None and (
@@ -163,6 +187,14 @@ class V1ServingSpec(BaseSchema):
                 tuple(self.max_new_buckets) if self.max_new_buckets else None
             ),
             request_timeout_s=float(self.request_timeout_s),
+            max_queue=int(self.max_queue),
+            default_deadline_ms=(
+                float(self.default_deadline_ms)
+                if self.default_deadline_ms is not None
+                else None
+            ),
+            drain_grace_s=float(self.drain_grace_s),
+            breaker_threshold=int(self.breaker_threshold),
         )
 
 

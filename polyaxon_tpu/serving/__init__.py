@@ -1,2 +1,10 @@
-from .batching import ServingConfig  # noqa: F401
+from .batching import (  # noqa: F401
+    CircuitBreaker,
+    DeadlineExceededError,
+    ServerClosingError,
+    ServingConfig,
+    ServingError,
+    ShedError,
+    WorkerCrashError,
+)
 from .server import ModelServer  # noqa: F401

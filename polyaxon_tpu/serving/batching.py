@@ -19,8 +19,31 @@ merge into one batched decode of up to `max_batch` rows, waiting at most
 handler threads through per-request events. Single-threaded jax
 tracing/execution holds by construction.
 
+Plus the resilience layer (ISSUE 5) — goodput under overload and failure:
+
+**Bounded queue + deadline-aware admission** — `submit` sheds with
+`ShedError` (HTTP 503 + Retry-After at the server) when the queue holds
+`max_queue` unfinished requests, when the request's deadline has already
+expired, or when the circuit breaker is open; the worker loop drops
+expired requests BEFORE spending a decode slot on them
+(`DeadlineExceededError`, HTTP 504). All deadline math uses
+`time.monotonic` (enforced by scripts/lint_telemetry.py).
+
+**Watchdog + circuit breaker** — the single worker thread is supervised:
+a crash fails its in-flight group fast (`WorkerCrashError`) and the loop
+restarts over the surviving queue. `breaker_threshold` consecutive
+decode failures trip a `CircuitBreaker` that sheds admissions until a
+half-open probe succeeds.
+
+**Graceful drain** — `stop(drain_s=...)` closes admission, lets the
+worker flush queued + in-flight groups within the budget, then fails the
+remainder with a terminal `ServerClosingError`.
+
 This module is deliberately free of jax: the ladder math and the worker
-loop are unit-testable with a fake executor (tests/test_serving_batch.py).
+loop are unit-testable with a fake executor (tests/test_serving_batch.py,
+tests/test_serving_resilience.py). Chaos points `serving.worker` (here)
+and `serving.decode`/`serving.slow` (server._execute_group) hook the
+seeded FaultPlan machinery into this path.
 """
 
 from __future__ import annotations
@@ -31,6 +54,51 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Optional
+
+from ..chaos.injector import inject
+
+
+# ------------------------------------------------------------------ errors
+class ServingError(RuntimeError):
+    """Client-visible serving failure. The HTTP layer maps the base class
+    to 400 (validation); the resilience subclasses below carry their own
+    status codes."""
+
+
+class ShedError(ServingError):
+    """Request shed at admission — queue full, breaker open, deadline
+    already expired, or the server is draining. HTTP 503 + Retry-After:
+    the request was NOT queued and is safe to retry elsewhere."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        reason: str = "overload",
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class ServerClosingError(ShedError):
+    """Terminal: the server is draining or shutting down. Queued requests
+    failed with this will never be retried here — go elsewhere."""
+
+    def __init__(self, message: str = "server shutting down"):
+        super().__init__(message, reason="closing", retry_after_s=1.0)
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed while it waited — dropped before a
+    decode slot was spent on it (goodput, not throughput). HTTP 504."""
+
+
+class WorkerCrashError(RuntimeError):
+    """The decode worker died with this group in flight; the watchdog
+    failed the group fast and restarted the worker. NOT a ServingError:
+    the client sees a 500, the request may or may not be safe to retry."""
 
 
 def bucket_ladder(lo: int, hi: int, factor: int = 2) -> tuple[int, ...]:
@@ -103,6 +171,12 @@ class ServingConfig:
     max_new_buckets: Optional[tuple[int, ...]] = None
     batching: bool = True
     request_timeout_s: float = 600.0
+    # resilience layer (ISSUE 5)
+    max_queue: int = 64  # unfinished requests admitted before shedding
+    default_deadline_ms: Optional[float] = None  # per-request deadlineMs wins
+    drain_grace_s: float = 5.0  # stop(): budget to flush in-flight work
+    breaker_threshold: int = 5  # consecutive decode failures → open
+    breaker_cooldown_s: float = 1.0  # open → half-open probe interval
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -132,6 +206,8 @@ class PendingRequest:
     max_new: int  # what the client asked for (<= key.new_bucket)
     seed: int
     key: GroupKey
+    # absolute monotonic deadline; None = no deadline (wait forever)
+    deadline: Optional[float] = None
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Optional[list] = None  # row token ids on success
@@ -142,17 +218,125 @@ class PendingRequest:
         self.error = error
         self.done.set()
 
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for the decode path.
+
+    closed → (threshold consecutive failures) → open → (cooldown elapses,
+    one probe admitted) → half_open → success closes / failure reopens.
+    A probe that never reports an outcome (dropped on deadline, shed on
+    shutdown) self-heals: another probe is admitted one cooldown later.
+
+    `threshold <= 0` disables the breaker (always closed). Thread-safe:
+    `allow()` runs on producer threads, `record_*` on the worker."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    _CODES = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown_s: float = 1.0,
+        on_change: Optional[Callable[[int], None]] = None,
+    ):
+        self.threshold = int(threshold)
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._on_change = on_change
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_code(self) -> int:
+        """0 closed, 1 open, 2 half-open — the serving.breaker_state gauge."""
+        return self._CODES[self.state]
+
+    def _set(self, state: str) -> None:
+        # callers hold _lock
+        if state == self._state:
+            return
+        self._state = state
+        if self._on_change is not None:
+            try:
+                self._on_change(self._CODES[state])
+            except Exception:  # noqa: BLE001 — telemetry must not break flow
+                pass
+
+    def allow(self) -> bool:
+        """Admission gate. In OPEN, flips to HALF_OPEN and admits ONE
+        probe once the cooldown has elapsed; in HALF_OPEN, re-admits a
+        probe every cooldown until some probe reports an outcome."""
+        if self.threshold <= 0:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if now - self._opened_at >= self.cooldown_s:
+                    self._set(self.HALF_OPEN)
+                    self._probe_at = now
+                    return True
+                return False
+            # HALF_OPEN: one probe per cooldown window
+            if now - self._probe_at >= self.cooldown_s:
+                self._probe_at = now
+                return True
+            return False
+
+    def record_success(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._failures = 0
+            self._set(self.CLOSED)
+
+    def record_failure(self) -> None:
+        if self.threshold <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                # the probe failed: straight back to open, restart cooldown
+                self._failures = self.threshold
+                self._opened_at = now
+                self._set(self.OPEN)
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._opened_at = now
+                self._set(self.OPEN)
+
 
 class DecodeCoalescer:
-    """Single consumer thread over a request queue.
+    """Single consumer thread over a BOUNDED request queue.
 
-    The worker drains the queue into a pending deque, takes the OLDEST
-    request's key, and gathers every same-key request (arrival order kept)
-    up to `max_batch`. A full batch flushes immediately; a partial one
-    waits until the oldest member is `max_wait_ms` old, so an isolated
-    request pays at most the wait and a burst pays (almost) nothing.
-    Requests with other keys stay pending — never reordered relative to
-    their own group, never starved (oldest-first head selection)."""
+    The worker drains the queue into a pending deque, drops anything whose
+    deadline already passed, takes the OLDEST live request's key, and
+    gathers every same-key request (arrival order kept) up to `max_batch`.
+    A full batch flushes immediately; a partial one waits until the oldest
+    member is `max_wait_ms` old, so an isolated request pays at most the
+    wait and a burst pays (almost) nothing. Requests with other keys stay
+    pending — never reordered relative to their own group, never starved
+    (oldest-first head selection).
+
+    Resilience: `submit` sheds (`ShedError`) at `max_queue` unfinished
+    requests, on expired deadlines, and while the breaker is open; the
+    worker thread is supervised (a crash fails its in-flight group fast
+    and the loop restarts); `stop(drain_s=...)` drains gracefully before
+    failing the remainder with `ServerClosingError`."""
 
     _SHUTDOWN = object()
 
@@ -162,36 +346,130 @@ class DecodeCoalescer:
         *,
         max_batch: int = 8,
         max_wait_ms: float = 5.0,
+        max_queue: int = 64,
+        breaker: Optional[CircuitBreaker] = None,
+        observer: Optional[Callable[..., None]] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._execute = execute
         self.max_batch = int(max_batch)
         self.max_wait = max(0.0, float(max_wait_ms)) / 1000.0
+        self.max_queue = int(max_queue)
+        self._breaker = breaker
+        self._observer = observer
         self._queue: queue.Queue = queue.Queue()
         self._pending: deque[PendingRequest] = deque()
+        self._inflight: Optional[list[PendingRequest]] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # occupancy telemetry (read by /statsz and serving_bench)
+        self._draining = threading.Event()
+        # unfinished requests in the coalescer's custody (queued, pending,
+        # or in flight) — the admission bound and the drain/idle signal
+        self._count_lock = threading.Lock()
+        self._outstanding = 0
+        # occupancy + resilience telemetry (read by /statsz and benches)
         self.batches_run = 0
         self.rows_run = 0
+        self.shed_total = 0
+        self.deadline_dropped = 0
+        self.worker_restarts = 0
+
+    # ----------------------------------------------------------- observers
+    def _observe(self, event: str, **ctx) -> None:
+        if self._observer is None:
+            return
+        try:
+            self._observer(event, **ctx)
+        except Exception:  # noqa: BLE001 — telemetry must not break serving
+            pass
+
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        return self._breaker
+
+    @property
+    def depth(self) -> int:
+        """Unfinished requests admitted and not yet resolved."""
+        with self._count_lock:
+            return self._outstanding
+
+    @property
+    def idle(self) -> bool:
+        return self.depth == 0
+
+    def _admit(self) -> None:
+        with self._count_lock:
+            self._outstanding += 1
+
+    def _resolve(self, n: int = 1) -> None:
+        with self._count_lock:
+            self._outstanding = max(0, self._outstanding - n)
 
     # ------------------------------------------------------------ producer
     def submit(self, req: PendingRequest):
+        """Admit one request, or shed it. Sheds are IMMEDIATE (the request
+        is never queued): `ShedError` for overload/breaker/expired-at-
+        admission, `ServerClosingError` while draining or stopped."""
         if self._stop.is_set():
-            raise RuntimeError("coalescer is stopped")
+            raise ServerClosingError("coalescer is stopped: shutting down")
+        if self._draining.is_set():
+            raise ServerClosingError("server draining: admission closed")
+        if req.expired():
+            self._shed(
+                "deadline", "request deadline already expired at admission"
+            )
+        if self._breaker is not None and not self._breaker.allow():
+            self._shed(
+                "breaker_open",
+                "circuit breaker open: decode is failing, try again later",
+                retry_after_s=max(1.0, self._breaker.cooldown_s),
+            )
+        if self.depth >= self.max_queue:
+            self._shed(
+                "queue_full",
+                f"decode queue full ({self.max_queue} requests in flight)",
+            )
+        self._admit()
         self._queue.put(req)
+
+    def _shed(self, reason: str, message: str, retry_after_s: float = 1.0):
+        with self._count_lock:
+            self.shed_total += 1
+        self._observe("shed", reason=reason)
+        raise ShedError(message, reason=reason, retry_after_s=retry_after_s)
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
         if self._thread is not None:
             return
         self._thread = threading.Thread(
-            target=self._loop, name="decode-coalescer", daemon=True
+            target=self._run, name="decode-coalescer", daemon=True
         )
         self._thread.start()
 
-    def stop(self, timeout: float = 10.0):
+    def drain(self, grace_s: float) -> bool:
+        """Close admission and wait up to `grace_s` for every admitted
+        request (queued + in flight) to resolve. Partial batches flush
+        immediately while draining. Returns True when fully flushed."""
+        self._draining.set()
+        end = time.monotonic() + max(0.0, float(grace_s))
+        while time.monotonic() < end:
+            if self.idle:
+                return True
+            time.sleep(0.005)
+        return self.idle
+
+    def stop(self, timeout: float = 10.0, drain_s: float = 0.0):
+        """Shut down. With `drain_s > 0`, first drain gracefully; whatever
+        remains (queued or parked) is failed FAST with a terminal
+        `ServerClosingError` — no client is left to ride out
+        `request_timeout_s` against a dead server."""
+        if self._thread is not None and drain_s > 0:
+            self.drain(drain_s)
+        self._draining.set()
         self._stop.set()
         self._queue.put(self._SHUTDOWN)
         if self._thread is not None:
@@ -206,7 +484,11 @@ class DecodeCoalescer:
             if item is not self._SHUTDOWN:
                 self._pending.append(item)
         for req in list(self._pending):
-            req.finish(error=RuntimeError("server shutting down"))
+            if not req.done.is_set():
+                req.finish(error=ServerClosingError(
+                    "server shutting down: request aborted"
+                ))
+            self._resolve()
         self._pending.clear()
 
     # ------------------------------------------------------------ consumer
@@ -229,9 +511,61 @@ class DecodeCoalescer:
                 return False
             self._pending.append(item)
 
+    def _drop_expired(self, req: PendingRequest) -> None:
+        self.deadline_dropped += 1
+        self._observe("deadline_dropped")
+        budget = ""
+        if req.deadline is not None:
+            budget = f" ({(req.deadline - req.enqueued_at) * 1e3:.0f}ms budget)"
+        req.finish(error=DeadlineExceededError(
+            f"deadline exceeded before decode dispatch{budget}"
+        ))
+        self._resolve()
+
+    def _purge_expired(self) -> None:
+        """Drop every pending request whose deadline has passed — BEFORE a
+        decode slot is spent on it (goodput over throughput)."""
+        if not self._pending:
+            return
+        now = time.monotonic()
+        dead = [r for r in self._pending if r.expired(now)]
+        for r in dead:
+            self._pending.remove(r)
+            self._drop_expired(r)
+
+    def _run(self):
+        """Worker thread body: `_loop` under a watchdog. A crash anywhere
+        in the loop fails the in-flight group fast (the clients see a
+        `WorkerCrashError`, not a `request_timeout_s` hang), counts a
+        breaker failure, and restarts the loop over the surviving queue."""
+        while True:
+            try:
+                self._loop()
+                return  # clean shutdown
+            except BaseException as e:  # noqa: BLE001 — supervise, restart
+                batch, self._inflight = self._inflight, None
+                for r in batch or ():
+                    if not r.done.is_set():
+                        r.finish(error=WorkerCrashError(
+                            f"decode worker crashed mid-group: {e!r}"
+                        ))
+                if batch:
+                    self._resolve(len(batch))
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                self.worker_restarts += 1
+                self._observe("worker_restart", error=repr(e))
+                if self._stop.is_set():
+                    return
+
     def _loop(self):
         alive = True
         while alive or self._pending:
+            if self._stop.is_set():
+                # stop() is failing the remainder fast — decoding on past
+                # the drain budget would silently overrun it
+                return
+            self._purge_expired()
             if not self._pending:
                 alive = self._drain_into_pending(timeout=0.1)
                 continue
@@ -241,20 +575,48 @@ class DecodeCoalescer:
             ]
             deadline = head.enqueued_at + self.max_wait
             now = time.monotonic()
-            if len(batch) < self.max_batch and now < deadline and alive:
+            if (
+                len(batch) < self.max_batch
+                and now < deadline
+                and alive
+                and not self._draining.is_set()
+            ):
                 # wait (bounded by the head's age) for coalescable arrivals
                 alive = self._drain_into_pending(timeout=deadline - now)
                 continue
             for r in batch:
                 self._pending.remove(r)
+            # last look before spending the slot: drop the already-dead
+            now = time.monotonic()
+            live = []
+            for r in batch:
+                if r.expired(now):
+                    self._drop_expired(r)
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            batch = live
+            self._inflight = batch
+            # chaos point: a "kill" here takes the worker thread down with
+            # this group in flight — the watchdog must recover
+            inject("serving.worker", rows=len(batch))
             self.batches_run += 1
             self.rows_run += len(batch)
             try:
                 self._execute(batch)
             except BaseException as e:  # noqa: BLE001 — scatter, don't die
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                self._observe("decode_error", error=type(e).__name__)
                 for r in batch:
                     if not r.done.is_set():
                         r.finish(error=e)
+            else:
+                if self._breaker is not None:
+                    self._breaker.record_success()
+            self._inflight = None
+            self._resolve(len(batch))
             # opportunistically pick up anything that arrived mid-execute
             if alive:
                 alive = self._drain_into_pending(timeout=None)

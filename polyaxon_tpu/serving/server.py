@@ -10,15 +10,23 @@ command:
 
 Endpoints:
   GET  /healthz           → {"status": "ok", "model": ..., "step": N}
+  GET  /readyz            → 200 {"ready": true} while accepting; 503 while
+                             draining or when --expected-devices detects a
+                             degraded slice (runtime/health.check_slice)
   GET  /statsz            → {"compile_count": N, "requests": N,
                              "batches": N, "mean_batch_occupancy": x,
-                             "latency_ms": {p50/p95/p99}, ...}
+                             "latency_ms": {p50/p95/p99}, "shed": N,
+                             "deadline_exceeded": N, "breaker": "closed",
+                             "queue_depth": N, ...}
   GET  /metricsz          → Prometheus text format, rendered from the
                              same telemetry registry as /statsz
   POST /generate          → {"tokens": [[...]]}
      body: {"tokens": [[int]], "maxNewTokens": int, "temperature": float,
-            "topK": int?, "eosId": int?, "seed": int?,
+            "topK": int?, "eosId": int?, "seed": int?, "deadlineMs": float?,
             "numBeams": int? (beam search when > 1), "lengthPenalty": float?}
+     errors: 400 validation; 503 + Retry-After shed (queue full, breaker
+     open, expired at admission, draining — never queued, retry later);
+     504 deadline exceeded while queued (dropped before dispatch).
 
 Design — the serving fast path (serving/batching.py):
 
@@ -41,18 +49,34 @@ at startup.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Httpd(ThreadingHTTPServer):
+    # socketserver's default accept backlog is 5 — an overload burst then
+    # gets TCP RSTs before the shed logic ever sees it. A server whose
+    # whole job under pressure is answering 503 fast must accept the
+    # connection to say so.
+    request_queue_size = 128
 from typing import Optional
 
+from ..chaos.injector import inject
 from ..store.local import RunStore
 from ..telemetry import MetricsRegistry, now as _now
 from .batching import (
+    CircuitBreaker,
+    DeadlineExceededError,
     DecodeCoalescer,
     GroupKey,
     PendingRequest,
+    ServerClosingError,
     ServingConfig,
+    ServingError,
+    ShedError,
     batch_bucket,
     choose_buckets,
 )
@@ -103,10 +127,6 @@ def _restore_params_subtree(ckpt_dir: str, abstract_params):
         mgr.close()
 
 
-class ServingError(RuntimeError):
-    pass
-
-
 class ModelServer:
     def __init__(
         self,
@@ -117,12 +137,19 @@ class ModelServer:
         step: int = 0,
         config: Optional[ServingConfig] = None,
         registry: Optional[MetricsRegistry] = None,
+        expected_devices: Optional[int] = None,
     ):
         self.module = module
         self.params = params
         self.model_name = model_name
         self.step = step
         self.config = config or ServingConfig()
+        # readiness: /readyz reports 503 while draining, and — when
+        # `expected_devices` is set — when the visible device count
+        # regresses below it (degraded slice; runtime/health.check_slice)
+        self.expected_devices = expected_devices
+        self._draining = False
+        self._health_cache: Optional[tuple[float, bool, str]] = None
         # ONE metrics pipeline: /statsz and /metricsz both render from
         # this registry, so the two surfaces cannot drift (pinned by
         # tests/test_telemetry.py). A server defaults to its own registry
@@ -154,6 +181,32 @@ class ModelServer:
             buckets=(1, 2, 4, 8, 16, 32, 64),
             help="Rows per dispatched decode batch",
         )
+        # resilience series — registered (and rendered) from startup so a
+        # scrape can alert on them before the first overload event
+        self._m_shed = self.telemetry.counter(
+            "serving.shed",
+            help="Requests shed at admission "
+            "(queue full / breaker open / expired / draining)",
+        )
+        self._m_deadline = self.telemetry.counter(
+            "serving.deadline_exceeded",
+            help="Requests that missed their deadline (shed at admission "
+            "or dropped before dispatch)",
+        )
+        self._m_worker_restarts = self.telemetry.counter(
+            "serving.worker_restarts",
+            help="Decode worker watchdog restarts",
+        )
+        self._m_breaker = self.telemetry.gauge(
+            "serving.breaker_state",
+            help="Decode circuit breaker: 0 closed, 1 open, 2 half-open",
+        )
+        self._m_breaker.set(0)
+        self._m_ready = self.telemetry.gauge(
+            "serving.ready",
+            help="Readiness (/readyz): 1 accepting, 0 draining/degraded",
+        )
+        self._m_ready.set(0)
         self._prompt_ladder, self._new_ladder = self.config.ladders(
             int(module.cfg.seq_len)
         )
@@ -174,11 +227,43 @@ class ModelServer:
         self._lock = threading.Lock()
         self._coalescer: Optional[DecodeCoalescer] = None
         if self.config.batching:
-            self._coalescer = DecodeCoalescer(
-                self._execute_group,
-                max_batch=self.config.max_batch,
-                max_wait_ms=self.config.max_wait_ms,
-            )
+            self._coalescer = self._make_coalescer()
+
+    def _make_coalescer(self) -> DecodeCoalescer:
+        breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s,
+            on_change=self._m_breaker.set,
+        )
+        return DecodeCoalescer(
+            self._execute_group,
+            max_batch=self.config.max_batch,
+            max_wait_ms=self.config.max_wait_ms,
+            max_queue=self.config.max_queue,
+            breaker=breaker,
+            observer=self._observe,
+        )
+
+    def _observe(self, event: str, **ctx) -> None:
+        """Coalescer → registry bridge: every resilience event lands on
+        /metricsz (and /statsz) through the one telemetry pipeline."""
+        if event == "shed":
+            self._m_shed.inc()
+            reason = ctx.get("reason", "overload")
+            self.telemetry.counter(
+                f"serving.shed.{reason}",
+                help=f"Requests shed at admission: {reason}",
+            ).inc()
+            if reason == "deadline":
+                self._m_deadline.inc()
+        elif event == "deadline_dropped":
+            self._m_deadline.inc()
+        elif event == "worker_restart":
+            self._m_worker_restarts.inc()
+        elif event == "decode_error":
+            self.telemetry.counter(
+                "serving.decode_errors", help="Decode batch failures"
+            ).inc()
 
     @property
     def compile_count(self) -> int:
@@ -294,6 +379,8 @@ class ModelServer:
         store: Optional[RunStore] = None,
         mesh_axes: Optional[dict] = None,
         config: Optional[ServingConfig] = None,
+        config_overrides: Optional[dict] = None,
+        expected_devices: Optional[int] = None,
     ):
         """Restore the latest checkpoint of a `transformer_lm` jaxjob run.
 
@@ -310,9 +397,12 @@ class ModelServer:
         XLA inserts the collectives from the param shardings (parity with
         single-device decoding is tested).
 
-        `config` overrides the batching knobs; absent, the stored spec's
-        `program.serving` section (schemas.run_kinds.V1ServingSpec)
-        provides defaults so a run can pin its own serving shape."""
+        `config` replaces the batching knobs wholesale; absent, the stored
+        spec's `program.serving` section (schemas.run_kinds.V1ServingSpec)
+        provides defaults so a run can pin its own serving shape.
+        `config_overrides` (field-name → value) layers individual knobs
+        over that base — a CLI `--max-queue 2` must not silently reset the
+        spec's `maxBatch` pin back to the library default."""
         import jax
 
         from ..models import build_model
@@ -339,6 +429,11 @@ class ModelServer:
             )
         if config is None and program.serving is not None:
             config = program.serving.to_config()
+        if config_overrides:
+            config = dataclasses.replace(
+                config if config is not None else ServingConfig(),
+                **config_overrides,
+            )
         # absolute: orbax's CheckpointManager rejects relative paths, and a
         # store rooted at a relative POLYAXON_HOME (CLI run from the store's
         # parent dir) would otherwise fail only at serve time
@@ -381,6 +476,7 @@ class ModelServer:
             model_name=program.model.name,
             step=step,
             config=config,
+            expected_devices=expected_devices,
         )
 
     # --------------------------------------------------------- validation
@@ -422,7 +518,21 @@ class ModelServer:
             raise ServingError(
                 f"numBeams must be in [1, {max_beams}]"
             )
+        # deadline: body deadlineMs wins, then the config default; absolute
+        # monotonic time from here on (time.monotonic ONLY — the telemetry
+        # lint rejects wall-clock deadline math in serving/)
+        deadline_ms = body.get("deadlineMs", self.config.default_deadline_ms)
+        deadline = None
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+            if deadline_ms <= 0:
+                raise ServingError(
+                    f"deadlineMs must be > 0, got {deadline_ms}"
+                )
+            deadline = time.monotonic() + deadline_ms / 1e3
         return {
+            "deadline": deadline,
+            "deadline_ms": deadline_ms,
             "arr": arr,
             "max_new": max_new,
             "temperature": float(body.get("temperature", 0.0)),
@@ -463,6 +573,7 @@ class ModelServer:
                     max_new=req["max_new"],
                     seed=req["seed"] + i,
                     key=key,
+                    deadline=req["deadline"],
                 )
             )
         return out
@@ -479,6 +590,11 @@ class ModelServer:
 
         key = batch[0].key
         n = len(batch)
+        # chaos points: "sleep" on serving.slow injects decode latency
+        # (deadline pressure), "raise" on serving.decode fails the batch
+        # (breaker material) — both seed-scheduled via FaultPlan
+        inject("serving.slow", rows=n)
+        inject("serving.decode", rows=n)
         qnow = _time.monotonic()  # same clock as PendingRequest.enqueued_at
         for r in batch:
             self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
@@ -592,16 +708,37 @@ class ModelServer:
             self._m_latency.observe(_now() - t0)
 
     def _handle_request(self, body: dict) -> dict:
+        if self._draining:
+            self._observe("shed", reason="draining")
+            raise ServerClosingError("server draining: admission closed")
         req = self._validate(body)
         if (
             self._coalescer is None
             or self._coalescer._thread is None
             or req["num_beams"] > 1
         ):
+            # synchronous path: decode starts immediately, so the only
+            # deadline that can already be lost is the admission one
+            if req["deadline"] is not None and time.monotonic() >= req["deadline"]:
+                self._observe("shed", reason="deadline")
+                raise ShedError(
+                    "deadline already expired at admission",
+                    reason="deadline",
+                )
             return self.generate(body)
         rows = self._make_requests(req)
-        for r in rows:
-            self._coalescer.submit(r)
+        submitted = []
+        try:
+            for r in rows:
+                self._coalescer.submit(r)
+                submitted.append(r)
+        except ShedError:
+            # multi-row body partially admitted: wait out the admitted rows
+            # (they resolve normally, results discarded) then report the
+            # shed — the client retries the whole body
+            for r in submitted:
+                r.done.wait(self.config.request_timeout_s)
+            raise
         timeout = self.config.request_timeout_s
         for r in rows:
             if not r.done.wait(timeout):
@@ -612,18 +749,61 @@ class ModelServer:
                 raise r.error
         return {"tokens": [r.result for r in rows]}
 
+    # --------------------------------------------------------- readiness
+    def readiness(self) -> tuple[bool, str]:
+        """(ready, reason) for /readyz. Not ready while draining/stopped,
+        or when `expected_devices` is set and the live device count has
+        regressed (degraded slice). Result lands on the serving.ready
+        gauge either way."""
+        if self._httpd is None or self._draining:
+            ready, reason = False, "draining" if self._draining else "stopped"
+        elif self.expected_devices is not None:
+            ready, reason = self._device_health()
+        else:
+            ready, reason = True, "ok"
+        self._m_ready.set(1 if ready else 0)
+        return ready, reason
+
+    def _device_health(self) -> tuple[bool, str]:
+        """check_slice(expected_devices=N), cached for 5s — the all-reduce
+        probe is cheap but not per-scrape cheap."""
+        now = time.monotonic()
+        if self._health_cache is not None and now - self._health_cache[0] < 5.0:
+            return self._health_cache[1], self._health_cache[2]
+        from ..runtime.health import SliceHealthError, check_slice
+
+        try:
+            info = check_slice(expected_devices=self.expected_devices)
+            out = (True, f"ok ({info['devices']} devices)")
+        except SliceHealthError as e:
+            out = (False, f"degraded slice: {e}")
+        self._health_cache = (now, out[0], out[1])
+        return out
+
     @staticmethod
     def _ms(v) -> Optional[float]:
         return round(v * 1e3, 3) if v is not None else None
 
     def stats(self) -> dict:
         batches = rows = 0
+        resilience = {}
         if self._coalescer is not None:
-            batches = self._coalescer.batches_run
-            rows = self._coalescer.rows_run
+            c = self._coalescer
+            batches = c.batches_run
+            rows = c.rows_run
+            resilience = {
+                "queue_depth": c.depth,
+                "max_queue": c.max_queue,
+                "shed": int(self._m_shed.value),
+                "deadline_exceeded": int(self._m_deadline.value),
+                "worker_restarts": c.worker_restarts,
+                "breaker": c.breaker.state if c.breaker else "disabled",
+                "draining": self._draining,
+            }
         lat = self._m_latency.summary()
         queue = self._m_queue_wait.summary()
         return {
+            **resilience,
             "batching": bool(self.config.batching),
             "compile_count": self.compile_count,
             "compile_cache": {
@@ -658,15 +838,22 @@ class ModelServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict, headers: dict = None):
                 self._send_raw(
-                    code, json.dumps(payload).encode(), "application/json"
+                    code,
+                    json.dumps(payload).encode(),
+                    "application/json",
+                    headers,
                 )
 
-            def _send_raw(self, code: int, data: bytes, ctype: str):
+            def _send_raw(
+                self, code: int, data: bytes, ctype: str, headers: dict = None
+            ):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -679,6 +866,12 @@ class ModelServer:
                             "model": server.model_name,
                             "step": server.step,
                         },
+                    )
+                elif self.path == "/readyz":
+                    ready, reason = server.readiness()
+                    self._send(
+                        200 if ready else 503,
+                        {"ready": ready, "reason": reason},
                     )
                 elif self.path == "/statsz":
                     self._send(200, server.stats())
@@ -699,28 +892,59 @@ class ModelServer:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
                     self._send(200, server.handle_request(body))
+                except ShedError as e:
+                    # shed at admission: never queued, safe to retry later
+                    self._send(
+                        503,
+                        {"error": str(e), "reason": e.reason},
+                        headers={
+                            "Retry-After": str(
+                                max(1, int(round(e.retry_after_s)))
+                            )
+                        },
+                    )
+                except DeadlineExceededError as e:
+                    self._send(
+                        504, {"error": str(e), "reason": "deadline_exceeded"}
+                    )
                 except ServingError as e:
                     self._send(400, {"error": str(e)})
+                except TimeoutError as e:
+                    self._send(504, {"error": str(e), "reason": "timeout"})
                 except Exception as e:  # noqa: BLE001 — surface, don't kill
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _Httpd((host, port), Handler)
+        self._draining = False
+        self._m_ready.set(1)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
         return self._httpd.server_address[1]
 
-    def stop(self):
+    def stop(self, drain_grace_s: Optional[float] = None):
+        """Graceful drain, then shutdown (SIGTERM semantics):
+
+        1. flip /readyz to 503 and close admission (new requests shed
+           with a terminal 503 ServerClosingError);
+        2. let the decode worker flush queued + in-flight groups for up
+           to the drain budget (config.drainGraceS unless overridden) —
+           the HTTP server keeps running so their responses go out;
+        3. fail whatever remains fast, then stop the HTTP server."""
+        grace = (
+            self.config.drain_grace_s
+            if drain_grace_s is None
+            else drain_grace_s
+        )
+        self._draining = True
+        self._m_ready.set(0)
+        if self._coalescer is not None:
+            self._coalescer.stop(drain_s=grace)
+            # a restarted server gets a fresh worker (and breaker)
+            self._coalescer = self._make_coalescer()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-        if self._coalescer is not None:
-            self._coalescer.stop()
-            # a restarted server gets a fresh worker
-            self._coalescer = DecodeCoalescer(
-                self._execute_group,
-                max_batch=self.config.max_batch,
-                max_wait_ms=self.config.max_wait_ms,
-            )
+        self._draining = False  # a restarted server admits again

@@ -18,12 +18,15 @@ sidecar/streams service can tail them without coordination.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from pathlib import Path
 from typing import Any, Iterator, Optional
 
 from ..schemas.lifecycle import V1Statuses, can_transition, is_done
+
+logger = logging.getLogger(__name__)
 
 
 class UnknownRunError(KeyError):
@@ -357,15 +360,46 @@ def _condition(status: str, reason: str = "", message: str = "") -> dict:
 
 
 def _write_json(path: Path, data: dict):
+    # crash-durable replace: the bytes must be on disk before the rename,
+    # and the rename itself must survive a power cut — fsync the file,
+    # then the parent directory entry
     tmp = path.with_suffix(".tmp")
-    tmp.write_text(json.dumps(data, indent=1, default=str))
+    with tmp.open("w") as f:
+        f.write(json.dumps(data, indent=1, default=str))
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    try:
+        dir_fd = os.open(path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:
+        # some filesystems (and platforms) refuse directory fsync; the
+        # file-level fsync above already bounds the damage to a stale name
+        pass
 
 
 def _read_json(path: Path) -> Optional[dict]:
     if not path.exists():
         return None
-    return json.loads(path.read_text())
+    try:
+        return json.loads(path.read_text())
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        # a torn/garbled file must not wedge every status poll — quarantine
+        # it (keeping the bytes for forensics) and report "nothing here"
+        quarantine = path.with_name(path.name + ".corrupt")
+        try:
+            os.replace(path, quarantine)
+        except OSError:
+            quarantine = None
+        logger.warning(
+            "store: undecodable JSON at %s (%s)%s",
+            path, e,
+            f" — quarantined to {quarantine}" if quarantine else "",
+        )
+        return None
 
 
 def _read_jsonl(path: Path) -> list[dict]:

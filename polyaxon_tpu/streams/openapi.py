@@ -61,6 +61,15 @@ def spec() -> dict:
                     "responses": {"200": {"description": "ok"}},
                 }
             },
+            "/readyz": {
+                "get": {
+                    "summary": "Service readiness (503 while draining)",
+                    "responses": {
+                        "200": {"description": "ready"},
+                        "503": {"description": "not ready / draining"},
+                    },
+                }
+            },
             "/metricsz": {
                 "get": {
                     "summary": "Process metrics, Prometheus text format",

@@ -5,6 +5,7 @@ Reference parity (SURVEY.md §2 "Streams" + the write side of §3 boundary #1
 over the run store — the same files the trainer/sidecar write. Endpoints:
 
   GET  /healthz
+  GET  /readyz
   GET  /runs                         → index (optionally ?project=)
   GET  /runs/<uuid>/status
   GET  /runs/<uuid>/logs[?offset=N]  → text; offset supports tail-follow
@@ -90,6 +91,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, INDEX_HTML.encode(), "text/html")
             if parts == ["healthz"]:
                 return self._send(200, _json_bytes({"status": "ok"}))
+            if parts == ["readyz"]:
+                # the store-backed service has no warmup phase: ready as
+                # soon as it serves. The route exists so one probe shape
+                # works across streams AND serving (which flips to 503
+                # while draining).
+                return self._send(200, _json_bytes({"ready": True}))
             if parts == ["metricsz"]:
                 # process-wide registry: run-store transitions, retry/
                 # backoff counters, chaos injections (telemetry package)

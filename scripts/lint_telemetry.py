@@ -17,6 +17,11 @@ deterministically. A raw `time.time()`/`time.monotonic()` there would be
 invisible to the simulated clock and silently skew queue-wait math, so
 both are forbidden outside `scheduler/clock.py`.
 
+Third rule: ONE deadline clock in serving. Deadline math in
+`polyaxon_tpu/serving/` must use `time.monotonic()` — a `time.time()`
+deadline jumps with NTP steps and DST, silently shedding live requests
+(or keeping dead ones), so raw `time.time()` is forbidden there.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -32,6 +37,7 @@ from pathlib import Path
 
 PATTERN = re.compile(r"\bperf_counter\b")
 SCHED_PATTERN = re.compile(r"\btime\.(?:time|monotonic)\s*\(")
+SERVING_PATTERN = re.compile(r"\btime\.time\s*\(")
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -43,6 +49,7 @@ def violations(repo_root: Path) -> list[str]:
             continue
         in_scheduler = rel.parts[:2] == ("polyaxon_tpu", "scheduler")
         clock_exempt = in_scheduler and rel.name == "clock.py"
+        in_serving = rel.parts[:2] == ("polyaxon_tpu", "serving")
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -53,6 +60,11 @@ def violations(repo_root: Path) -> list[str]:
                 out.append(
                     f"{rel}:{i}: raw wall clock in scheduler/ "
                     f"(use scheduler.clock.Clock): {line.strip()}"
+                )
+            if in_serving and SERVING_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: time.time() in serving/ — deadlines "
+                    f"must use time.monotonic(): {line.strip()}"
                 )
     return out
 

@@ -171,6 +171,29 @@ PY
       echo "FLEET-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # overload resilience gate: drive the serving stack at 5x its
+    # calibrated capacity (benchmarks/serving_overload_bench.py --smoke
+    # asserts zero hung requests, a positive shed rate, and bounded
+    # admitted latency) and require the resilience series in the
+    # /metricsz text it captured. A server that strands requests under
+    # overload — or sheds invisibly — FAILS the canary.
+    echo "running overload smoke $(date -u +%T)" >> "$log"
+    if ! timeout 900 python benchmarks/serving_overload_bench.py --smoke \
+        --metricsz-out tpu_results/overload_metricsz_tpu.txt \
+        > tpu_results/overload_tpu.json 2>> "$log"; then
+      echo "OVERLOAD-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      cat tpu_results/overload_tpu.json >> "$log" 2>/dev/null
+      exit 1
+    fi
+    cat tpu_results/overload_tpu.json >> "$log"
+    for series in serving_shed_total serving_deadline_exceeded_total \
+        serving_breaker_state serving_worker_restarts_total serving_ready; do
+      if ! grep -q "$series" tpu_results/overload_metricsz_tpu.txt; then
+        echo "OVERLOAD-SMOKE-FAILED: missing series $series $(date -u +%T)" >> "$log"
+        exit 1
+      fi
+    done
+    echo "overload smoke: ok $(date -u +%T)" >> "$log"
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
