@@ -13,14 +13,27 @@ tests/test_benchmarks.py::test_decode_bench_schema:
   {"metric": "decode_tokens_per_sec", "value": N, "unit": "tok/s",
    "platform": "...", "device_kind": "...", "n_heads": H, "n_kv_heads": K,
    "cache_len": S, "kv_cache_bytes": B, "batch": b, "prompt_len": p,
-   "max_new": n, "prefill_ms": ..., "per_token_ms": ..., ...}
+   "max_new": n, "prefill_ms": ..., "per_token_ms": ..., "ttft_ms": ...}
+
+The base config also reports the block-paged decode path (ISSUE 6):
+
+  {"metric": "paged_decode_tokens_per_sec", "value": N, "unit": "tok/s",
+   "page_tokens": t, "pool_pages": p, "kv_pool_bytes": B,
+   "ttft_ms": ..., "per_token_ms": ..., "cache_donated": true}
+
+`cache_donated` asserts the prefill→decode buffer donation
+(jit_paged_prefill/jit_paged_chunk use donate_argnums on the pool): on
+TPU the donated input buffer MUST be invalidated (hard assert); CPU
+ignores donation, so there it is report-only.
 
   python benchmarks/decode_bench.py            # default sweep
+  python benchmarks/decode_bench.py --smoke    # tiny sweep on any backend
   POLYAXON_JAX_PLATFORM=cpu python benchmarks/decode_bench.py  # smoke
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 from pathlib import Path
@@ -65,7 +78,119 @@ def sweep_configs(on_tpu: bool):
         yield cfg, batch, cache_len // 2, max_new, False
 
 
-def main():
+def run_paged(bundle, params, cfg, batch, prompt_len, max_new, device, timed):
+    """Paged-decode record for the base config: TTFT (prefill + first
+    sample), steady-state tok/s through the page tables, and the donation
+    assertion (the prefill cache buffer must be consumed in place)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyaxon_tpu.models.generate import (
+        jit_paged_chunk,
+        jit_paged_prefill,
+        make_paged_cache,
+    )
+    from polyaxon_tpu.models.kv_pages import PagedKVLayout
+
+    pt = max(8, min(128, cfg["seq_len"] // 8))
+    window = prompt_len + max_new
+    n_pages = -(-window // pt)
+    layout = PagedKVLayout(
+        page_tokens=pt, pool_pages=batch * n_pages + 1
+    )
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg["vocab_size"],
+        dtype=jnp.int32,
+    )
+    pads = jnp.zeros((batch,), jnp.int32)
+    seeds = jnp.arange(batch, dtype=jnp.int32)
+    # page 0 = scratch; each row owns a disjoint stripe of the pool
+    tables = jnp.asarray(
+        1 + np.arange(batch * n_pages, dtype=np.int32).reshape(batch, n_pages)
+    )
+    pf = jit_paged_prefill(
+        bundle.module, kv_layout=layout, prefix_len=0, temperature=0.8,
+        top_k=40,
+    )
+    steps = max_new - 1
+    cf = (
+        jit_paged_chunk(
+            bundle.module, steps=steps, kv_layout=layout, prefix_len=0,
+            temperature=0.8, top_k=40, eos_id=None,
+        )
+        if steps > 0
+        else None
+    )
+
+    def fresh():
+        return make_paged_cache(bundle.module, params, layout)
+
+    # donation check: the pool buffer fed to prefill must be invalidated
+    # (consumed in place) — TPU hard-asserts, CPU ignores donation
+    probe = fresh()
+    probe_leaf = jax.tree.leaves(probe)[0]
+    cache, first = pf(params, probe, prompt, pads, tables, seeds)
+    jax.block_until_ready(first)
+    donated = bool(probe_leaf.is_deleted())
+    if device.platform == "tpu":
+        assert donated, (
+            "paged prefill cache was copied, not donated — "
+            "donate_argnums regression"
+        )
+    # TTFT: prefill + first sampled token, end to end
+    t0 = _time.perf_counter()
+    cache2, first2 = pf(params, fresh(), prompt, pads, tables, seeds)
+    jax.block_until_ready(first2)
+    ttft_ms = (_time.perf_counter() - t0) * 1e3
+    per_token_ms = None
+    toks_per_sec = None
+    if cf is not None:
+        done = jnp.zeros((batch,), bool)
+        pos = jnp.asarray(prompt_len, jnp.int32)
+        g = jnp.asarray(1, jnp.int32)
+
+        def decode(cache, tok, done):
+            return cf(params, cache, tok, done, pads, tables, seeds, pos, g)
+
+        cache2, toks, done = decode(cache2, first2, done)  # warm compile
+        jax.block_until_ready(toks)
+        t0 = _time.perf_counter()
+        cache2, toks, done = decode(cache2, toks[:, -1], done)
+        jax.block_until_ready(toks)
+        dt = _time.perf_counter() - t0
+        per_token_ms = dt / steps * 1e3
+        toks_per_sec = batch * steps / dt
+    head_dim = cfg["dim"] // cfg["n_heads"]
+    kv_pool_bytes = (
+        2 * 2 * cfg["n_layers"] * layout.pool_pages * pt
+        * cfg["n_kv_heads"] * head_dim
+    )
+    print(json.dumps({
+        "metric": "paged_decode_tokens_per_sec",
+        "value": round(toks_per_sec, 1) if toks_per_sec else None,
+        "unit": "tok/s",
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
+        "page_tokens": pt,
+        "pool_pages": layout.pool_pages,
+        "kv_pool_bytes": kv_pool_bytes,
+        "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
+        "ttft_ms": round(ttft_ms, 2),
+        "per_token_ms": round(per_token_ms, 3) if per_token_ms else None,
+        "cache_donated": donated,
+    }), flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sweep regardless of backend (CI)")
+    args = ap.parse_args(argv)
+
     from polyaxon_tpu.utils.jax_platform import apply_platform_env
 
     apply_platform_env()
@@ -79,7 +204,7 @@ def main():
     from _timing import time_call
 
     device = jax.devices()[0]
-    on_tpu = device.platform == "tpu"
+    on_tpu = device.platform == "tpu" and not args.smoke
 
     def timed(fn, *args):
         return time_call(fn, *args, iters=3)
@@ -136,11 +261,25 @@ def main():
             "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
             "prefill_ms": round(dt_prefill * 1e3, 2),
             "per_token_ms": round(decode_dt / (max_new - 1) * 1e3, 3),
+            # dense decode emits nothing until the whole batch finishes:
+            # its TTFT is the 1-token end-to-end time (the paged record
+            # below is what streaming actually delivers)
+            "ttft_ms": round(dt_prefill * 1e3, 2),
             "end_to_end_s": round(dt, 3),
         }), flush=True)
 
         if not is_base:
             continue
+        try:
+            run_paged(
+                bundle, params, cfg, batch, prompt_len, max_new, device,
+                timed,
+            )
+        except Exception as e:  # noqa: BLE001 — report, keep sweeping
+            print(json.dumps({
+                "metric": "paged_decode_tokens_per_sec",
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }), flush=True)
         nb = 4
         b = jax.jit(
             lambda p, pr: beam_search(

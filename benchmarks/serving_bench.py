@@ -18,13 +18,26 @@ family as the other benches (tests/test_bench_script.py pins it):
 
   {"metric": "serving_requests_per_sec", "value": ..., "unit": "req/s",
    "mode": "batched", "clients": 16, "requests": 96, "p50_ms": ...,
-   "p95_ms": ..., "compile_count": 4, "batches": ...,
-   "mean_batch_occupancy": ..., "platform": ..., "device_kind": ...}
+   "p95_ms": ..., "ttft_p50_ms": ..., "ttft_p95_ms": ...,
+   "compile_count": 4, "batches": ..., "mean_batch_occupancy": ...,
+   "kv_pages_total": ..., "kv_pages_used_hwm": ..., "prefix_hit_rate": ...,
+   "platform": ..., "device_kind": ...}
   {"metric": "serving_batched_speedup", "value": 3.1, "unit": "x", ...}
+
+`--shared-prefix` runs the ISSUE 6 demonstration instead: a paged server
+(KV page pool + prefix cache + streaming), one cold request that pays the
+full prefill, then a warm burst sharing the same page-aligned prompt
+prefix. Warm requests skip the shared prefill entirely — the record pins
+hit rate and the client-measured (streamed) TTFT drop:
+
+  {"metric": "serving_prefix_reuse_ttft_speedup", "value": ..., "unit": "x",
+   "ttft_cold_ms": ..., "ttft_warm_p50_ms": ..., "ttft_warm_p95_ms": ...,
+   "prefix_hit_rate": ..., "kv_pages_total": ..., "kv_pages_used_hwm": ...}
 
   python benchmarks/serving_bench.py                 # full: 16 clients
   python benchmarks/serving_bench.py --smoke         # CI smoke: 4 clients
   python benchmarks/serving_bench.py --mode batched  # one side only
+  python benchmarks/serving_bench.py --shared-prefix # prefix-reuse demo
 """
 
 from __future__ import annotations
@@ -83,7 +96,10 @@ def make_traffic(n_requests: int, seed: int) -> list[dict]:
     return out
 
 
-def build_server(batching: bool, max_batch: int, max_wait_ms: float):
+def build_server(batching: bool, max_batch: int, max_wait_ms: float,
+                 kv_pool_pages: int | None = None,
+                 kv_page_tokens: int = 16,
+                 stream_chunk_tokens: int = 4):
     import jax
     import jax.numpy as jnp
 
@@ -102,16 +118,57 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float):
         params,
         model_name="serving-bench",
         config=ServingConfig(
-            batching=batching, max_batch=max_batch, max_wait_ms=max_wait_ms
+            batching=batching, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            kv_pool_pages=kv_pool_pages, kv_page_tokens=kv_page_tokens,
+            stream_chunk_tokens=stream_chunk_tokens,
         ),
     )
 
 
+def _stream_ttft(host: str, port: int, body: dict,
+                 timeout: float = 300.0) -> tuple[float, list[int]]:
+    """POST /generate?stream=1 and return (client-measured TTFT seconds,
+    generated tokens of row 0) — TTFT is wall time to the first `tokens`
+    SSE frame, the number a user actually experiences."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate?stream=1", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        raise RuntimeError(f"stream status {resp.status}: {resp.read()!r}")
+    ttft = None
+    tokens: list[int] = []
+    buf = b""
+    while True:
+        chunk = resp.read(64)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            ev = json.loads(frame[len(b"data: "):])
+            if "tokens" in ev and ev.get("row") == 0:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                tokens.extend(ev["tokens"])
+    conn.close()
+    if ttft is None:
+        raise RuntimeError("stream produced no token frames")
+    return ttft, tokens
+
+
 def drive(mode: str, traffic: list[dict], clients: int, max_batch: int,
-          max_wait_ms: float) -> dict:
+          max_wait_ms: float, kv_pool_pages: int | None = None) -> dict:
     """Run one server in `mode`, fire the traffic from `clients` threads,
-    return the stats record."""
-    server = build_server(mode == "batched", max_batch, max_wait_ms)
+    return the stats record. Mode `paged` is `batched` plus the block-
+    paged KV pool (admission by page reservation + prefix cache)."""
+    server = build_server(
+        mode in ("batched", "paged"), max_batch, max_wait_ms,
+        kv_pool_pages=kv_pool_pages if mode == "paged" else None,
+    )
     port = server.start(port=0)
     url = f"http://127.0.0.1:{port}/generate"
     # round-robin the SAME traffic across client threads so both modes see
@@ -160,6 +217,13 @@ def drive(mode: str, traffic: list[dict], clients: int, max_batch: int,
 
     device = jax.devices()[0]
     lat_ms = sorted(l * 1e3 for l in latencies)
+    kv = stats.get("kv") or {}
+    prefix = kv.get("prefix") or {}
+    lookups = prefix.get("hits", 0) + prefix.get("misses", 0)
+    # non-streamed requests deliver their first token with the response,
+    # so client-side TTFT == request latency; the paged server also
+    # reports true (first-sample) TTFT through its own histogram
+    ttft = kv.get("ttft_ms") or {}
     rec = {
         "metric": "serving_requests_per_sec",
         "value": round(len(latencies) / wall, 2) if wall > 0 else 0.0,
@@ -170,6 +234,21 @@ def drive(mode: str, traffic: list[dict], clients: int, max_batch: int,
         "wall_s": round(wall, 2),
         "p50_ms": round(quantile(lat_ms, 0.5), 1) if lat_ms else None,
         "p95_ms": round(quantile(lat_ms, 0.95), 1) if lat_ms else None,
+        "ttft_p50_ms": (
+            ttft.get("p50")
+            if kv.get("enabled")
+            else (round(quantile(lat_ms, 0.5), 1) if lat_ms else None)
+        ),
+        "ttft_p95_ms": (
+            ttft.get("p95")
+            if kv.get("enabled")
+            else (round(quantile(lat_ms, 0.95), 1) if lat_ms else None)
+        ),
+        "kv_pages_total": kv.get("pages_total", 0),
+        "kv_pages_used_hwm": kv.get("pages_hwm", 0),
+        "prefix_hit_rate": (
+            round(prefix.get("hits", 0) / lookups, 3) if lookups else None
+        ),
         "compile_count": stats["compile_count"],
         "batches": stats["batches"],
         "mean_batch_occupancy": stats["mean_batch_occupancy"],
@@ -182,6 +261,74 @@ def drive(mode: str, traffic: list[dict], clients: int, max_batch: int,
     return rec
 
 
+def drive_shared_prefix(warm_requests: int, max_batch: int,
+                        max_wait_ms: float, kv_pool_pages: int,
+                        seed: int) -> dict:
+    """ISSUE 6 demonstration: paged server, one cold request paying the
+    full prefill, then a warm burst sharing the same page-aligned prompt
+    prefix. Warm rows alias the cached prefix pages (copy-on-write) and
+    prefill only their short suffixes — hit rate must be > 0 and the
+    streamed (client-measured) TTFT must drop."""
+    page_tokens = 16
+    server = build_server(
+        True, max_batch, max_wait_ms,
+        kv_pool_pages=kv_pool_pages, kv_page_tokens=page_tokens,
+    )
+    port = server.start(port=0)
+    rng = random.Random(seed)
+    # a long system-prompt-shaped prefix: 3 full pages, page-aligned so
+    # the harvest of the cold request indexes exactly this content
+    shared = [rng.randrange(MODEL_CFG["vocab_size"])
+              for _ in range(3 * page_tokens)]
+
+    def body(suffix_len: int, req_seed: int) -> dict:
+        return {
+            "tokens": [shared + [rng.randrange(MODEL_CFG["vocab_size"])
+                                 for _ in range(suffix_len)]],
+            "maxNewTokens": 8, "temperature": 0.8, "topK": 40,
+            "seed": req_seed,
+        }
+
+    ttft_cold, _ = _stream_ttft("127.0.0.1", port, body(6, 0))
+    warm = []
+    for i in range(warm_requests):
+        dt, toks = _stream_ttft("127.0.0.1", port, body(4 + i % 5, i + 1))
+        if not toks:
+            raise RuntimeError("warm request produced no tokens")
+        warm.append(dt * 1e3)
+    stats = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statsz", timeout=30
+        ).read()
+    )
+    server.stop()
+    kv = stats["kv"]
+    prefix = kv["prefix"]
+    lookups = prefix["hits"] + prefix["misses"]
+    warm_sorted = sorted(warm)
+    warm_p50 = quantile(warm_sorted, 0.5)
+    import jax
+
+    device = jax.devices()[0]
+    return {
+        "metric": "serving_prefix_reuse_ttft_speedup",
+        "value": round(ttft_cold * 1e3 / warm_p50, 2) if warm_p50 else None,
+        "unit": "x",
+        "ttft_cold_ms": round(ttft_cold * 1e3, 1),
+        "ttft_warm_p50_ms": round(warm_p50, 1),
+        "ttft_warm_p95_ms": round(quantile(warm_sorted, 0.95), 1),
+        "warm_requests": warm_requests,
+        "shared_prefix_tokens": len(shared),
+        "page_tokens": page_tokens,
+        "prefix_hit_rate": round(prefix["hits"] / lookups, 3),
+        "prefix_hits": prefix["hits"],
+        "kv_pages_total": kv["pages_total"],
+        "kv_pages_used_hwm": kv["pages_hwm"],
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=16)
@@ -189,8 +336,14 @@ def main(argv=None):
                     help="total requests per mode")
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-wait-ms", type=float, default=20.0)
-    ap.add_argument("--mode", choices=("both", "batched", "per_request"),
+    ap.add_argument("--mode",
+                    choices=("both", "batched", "per_request", "paged"),
                     default="both")
+    ap.add_argument("--kv-pool-pages", type=int, default=256,
+                    help="KV pool size for --mode paged / --shared-prefix")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="run the prefix-reuse TTFT demonstration instead "
+                         "of the traffic sweep")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true",
                     help="small CI configuration (4 clients, 12 requests)")
@@ -204,6 +357,15 @@ def main(argv=None):
 
     apply_platform_env()
 
+    if args.shared_prefix:
+        warm = 4 if args.smoke else 12
+        rec = drive_shared_prefix(
+            warm, args.max_batch, args.max_wait_ms, args.kv_pool_pages,
+            args.seed,
+        )
+        print(json.dumps(rec), flush=True)
+        return 0 if rec["prefix_hit_rate"] > 0 else 1
+
     traffic = make_traffic(args.requests, args.seed)
     modes = (
         ("per_request", "batched") if args.mode == "both" else (args.mode,)
@@ -211,7 +373,8 @@ def main(argv=None):
     recs = {}
     for mode in modes:
         recs[mode] = drive(
-            mode, traffic, args.clients, args.max_batch, args.max_wait_ms
+            mode, traffic, args.clients, args.max_batch, args.max_wait_ms,
+            kv_pool_pages=args.kv_pool_pages,
         )
         print(json.dumps(recs[mode]), flush=True)
     if len(recs) == 2 and recs["per_request"]["value"] > 0:

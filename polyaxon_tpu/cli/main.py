@@ -632,9 +632,21 @@ def agent_drain(queues):
 @click.option("--expected-devices", default=None, type=int,
               help="wire slice health into /readyz: report not-ready when "
                    "fewer than N devices respond")
+@click.option("--kv-pool-pages", default=None, type=int,
+              help="size of the block-paged KV pool in pages: admission "
+                   "reserves pages instead of worst-case rows, prompt "
+                   "prefixes are cached across requests, and decode "
+                   "streams (default: off — dense per-group caches)")
+@click.option("--kv-page-tokens", default=None, type=int,
+              help="KV page granularity in tokens (default 128)")
+@click.option("--no-prefix-cache", is_flag=True,
+              help="disable cross-request prefix KV reuse (paged pool only)")
+@click.option("--no-stream", is_flag=True,
+              help="disable POST /generate?stream=1 incremental delivery")
 def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
-          expected_devices):
+          expected_devices, kv_pool_pages, kv_page_tokens, no_prefix_cache,
+          no_stream):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
@@ -665,6 +677,10 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
             )
     if no_batching:
         overrides["batching"] = False
+    if no_prefix_cache:
+        overrides["prefix_cache"] = False
+    if no_stream:
+        overrides["stream"] = False
     for field, value in (
         ("max_batch", max_batch),
         ("max_wait_ms", max_wait_ms),
@@ -672,6 +688,8 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         ("default_deadline_ms", default_deadline_ms),
         ("drain_grace_s", drain_grace_s),
         ("breaker_threshold", breaker_threshold),
+        ("kv_pool_pages", kv_pool_pages),
+        ("kv_page_tokens", kv_page_tokens),
     ):
         if value is not None:
             overrides[field] = value
@@ -689,6 +707,11 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         if server.config.batching
         else "per-request (no batching)"
     )
+    if server.config.batching and server.config.kv_pool_pages:
+        mode += (
+            f" kv_pool={server.config.kv_pool_pages}x"
+            f"{server.config.kv_page_tokens}tok"
+        )
     click.echo(
         f"serving {server.model_name} (step {server.step}) "
         f"on http://{host}:{bound} [{mode}] — "

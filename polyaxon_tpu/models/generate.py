@@ -26,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .kv_pages import PagedKVLayout
+
 
 def _top_k_mask(logits, top_k: Optional[int]):
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
@@ -179,6 +181,201 @@ def generate(
             jnp.arange(P, total - 1),
         )
     return buf
+
+
+# --------------------------------------------------------------- paged decode
+# The block-paged pipeline (ISSUE 6): the KV cache is ONE pool of
+# page-sized blocks shared by every in-flight request, indexed through
+# per-row page tables, so serving admits against pool pages instead of
+# reserving seq_len per row. Decode runs as prefill + fixed-size chunks
+# (the serving layer allocates pages lazily between chunks and streams
+# each chunk's tokens out), and the jit factories below DONATE the cache
+# argument into each program, so the pool is updated in place — peak HBM
+# never holds two copies across the prefill→decode handoff.
+#
+# Determinism contract: for the same per-row seeds/pads, the token
+# sequence is byte-identical to the dense generate() path — same rope
+# positions (slot - pad), same masked-softmax (dead slots underflow to
+# exact 0.0 regardless of window width), same per-generation-index
+# sample streams (tests/test_kv_pages.py pins this across the ladder).
+
+
+def _row_rngs(row_keys, g):
+    """Per-row sample keys for generation index `g` — the same fold the
+    dense path uses, so coalescing/paging never changes a row's stream."""
+    return jax.vmap(lambda k: jax.random.fold_in(k, g))(row_keys)
+
+
+def make_paged_cache(module, params, layout: PagedKVLayout):
+    """Materialize the pool-shaped cache pytree (zeros) via the standard
+    creation apply. Leaves are [pool_pages, page_tokens, nkv, hd] (with a
+    leading [n_layers] under scan_layers) — batch-size independent, so one
+    pool serves every group shape."""
+    _, init_vars = module.apply(
+        {"params": params},
+        jnp.zeros((1, 1), jnp.int32),
+        train=False,
+        decode=True,
+        mutable=["cache"],
+        pages=jnp.zeros((1, 1), jnp.int32),
+        kv_layout=layout,
+    )
+    return init_vars["cache"]
+
+
+def paged_prefill(
+    module,
+    params,
+    cache,
+    prompt: jnp.ndarray,
+    *,
+    pad,
+    pages,
+    kv_layout: PagedKVLayout,
+    prefix_len: int,
+    temperature: float,
+    top_k: Optional[int],
+    seeds,
+) -> tuple:
+    """Prefill `prompt` [B, S] (LEFT-padded suffixes when a shared prefix
+    of `prefix_len` tokens is already in the pool) through the page
+    tables, starting at slot `prefix_len`, and sample the first new token
+    per row (generation index 0). Returns (cache, first_tokens [B])."""
+    logits, vars1 = module.apply(
+        {"params": params, "cache": cache},
+        prompt.astype(jnp.int32),
+        train=False,
+        decode=True,
+        mutable=["cache"],
+        pad=pad,
+        pages=pages,
+        pos=jnp.asarray(prefix_len, jnp.int32),
+        kv_layout=kv_layout,
+        prefix_len=prefix_len,
+    )
+    row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
+    first = _sample_rows(
+        logits[:, -1].astype(jnp.float32),
+        _row_rngs(row_keys, 0),
+        temperature,
+        top_k,
+    )
+    return vars1["cache"], first
+
+
+def paged_decode_chunk(
+    module,
+    params,
+    cache,
+    tok,
+    done,
+    *,
+    steps: int,
+    pos,
+    start_g,
+    pad,
+    pages,
+    kv_layout: PagedKVLayout,
+    prefix_len: int,
+    temperature: float,
+    top_k: Optional[int],
+    eos_id: Optional[int],
+    seeds,
+) -> tuple:
+    """Run `steps` cached decode steps through the page table.
+
+    `tok` [B] is the previously sampled (not yet fed) token, written at
+    slot `pos`; `start_g` is the generation index of the FIRST token this
+    chunk samples; `done` [B] carries the eos latch between chunks.
+    Returns (cache, toks [B, steps], done) — eos semantics identical to
+    generate(): done latches when a GENERATED eos is fed, later samples
+    are pinned to eos."""
+    row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
+    pos = jnp.asarray(pos, jnp.int32)
+    start_g = jnp.asarray(start_g, jnp.int32)
+
+    def step(carry, i):
+        cache, tok, done = carry
+        logits, out_vars = module.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            train=False,
+            decode=True,
+            mutable=["cache"],
+            pad=pad,
+            pages=pages,
+            pos=pos + i,
+            kv_layout=kv_layout,
+            prefix_len=prefix_len,
+        )
+        nxt = _sample_rows(
+            logits[:, -1].astype(jnp.float32),
+            _row_rngs(row_keys, start_g + i),
+            temperature,
+            top_k,
+        )
+        if eos_id is not None:
+            done = done | (tok == eos_id)
+            nxt = jnp.where(done, eos_id, nxt)
+        return (out_vars["cache"], nxt, done), nxt
+
+    (cache, _, done), toks = jax.lax.scan(
+        step,
+        (cache, jnp.asarray(tok, jnp.int32), done),
+        jnp.arange(int(steps)),
+    )
+    return cache, toks.T, done
+
+
+def jit_paged_prefill(
+    module,
+    *,
+    kv_layout: PagedKVLayout,
+    prefix_len: int,
+    temperature: float,
+    top_k: Optional[int],
+):
+    """Compiled prefill: (params, cache, prompt, pad, pages, seeds) →
+    (cache', first). The cache argument is DONATED — the pool is updated
+    in place, never duplicated (on backends without donation support,
+    e.g. CPU, jax falls back to a copy with a warning)."""
+
+    def run(params, cache, prompt, pad, pages, seeds):
+        return paged_prefill(
+            module, params, cache, prompt,
+            pad=pad, pages=pages, kv_layout=kv_layout,
+            prefix_len=prefix_len, temperature=temperature, top_k=top_k,
+            seeds=seeds,
+        )
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+def jit_paged_chunk(
+    module,
+    *,
+    steps: int,
+    kv_layout: PagedKVLayout,
+    prefix_len: int,
+    temperature: float,
+    top_k: Optional[int],
+    eos_id: Optional[int],
+):
+    """Compiled decode chunk: (params, cache, tok, done, pad, pages,
+    seeds, pos, start_g) → (cache', toks [B, steps], done'). Cache is
+    DONATED (see jit_paged_prefill); pos/start_g are traced scalars so
+    successive chunks reuse one compile."""
+
+    def run(params, cache, tok, done, pad, pages, seeds, pos, start_g):
+        return paged_decode_chunk(
+            module, params, cache, tok, done,
+            steps=steps, pos=pos, start_g=start_g, pad=pad, pages=pages,
+            kv_layout=kv_layout, prefix_len=prefix_len,
+            temperature=temperature, top_k=top_k, eos_id=eos_id,
+            seeds=seeds,
+        )
+
+    return jax.jit(run, donate_argnums=(1,))
 
 
 def beam_search(

@@ -153,7 +153,19 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False, decode: bool = False, pad=None):
+    def __call__(
+        self,
+        x,
+        *,
+        train: bool = False,
+        decode: bool = False,
+        pad=None,
+        pages=None,  # [B, n_pages] page table → block-paged KV (ISSUE 6)
+        pos=None,  # traced int32 scalar: first cache slot this call writes
+        kv_layout=None,  # kv_pages.PagedKVLayout (static pool shape)
+        prefix_len: int = 0,  # static: slots [0, prefix_len) hold a shared
+        # prefilled prefix; the row's own tokens start (left-padded) after it
+    ):
         cfg = self.cfg
         B, S, _ = x.shape
         hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -174,24 +186,59 @@ class Attention(nn.Module):
             # cache and attend the single query against the filled prefix.
             # Standard flax recipe — variables materialize on the first
             # mutable("cache") apply; cache holds nkv (pre-GQA) heads.
+            #
+            # Two cache layouts share the math below:
+            #  * dense (pages=None): per-request [B, seq_len] slabs with a
+            #    cache_index variable — every admitted row pays worst-case
+            #    seq_len of HBM for its whole lifetime;
+            #  * paged (pages=[B, n_pages]): one POOL of page-sized blocks
+            #    [pool_pages, page_tokens, nkv, hd] shared by all requests,
+            #    indexed through the per-row page table. The pool persists
+            #    across batches, so the write position `pos` is a traced
+            #    argument instead of a cache variable, and the attention
+            #    window is the table span (n_pages * page_tokens), not
+            #    seq_len. Slot semantics are unchanged — slot s holds the
+            #    row's true position s - pad[b] — so the masked-softmax
+            #    output is byte-identical to the dense path (dead slots
+            #    score -1e30, whose exp underflows to exact 0.0).
             is_step = self.has_variable("cache", "cached_key")
-            cached_k = self.variable(
-                "cache", "cached_key",
-                lambda: jnp.zeros((B, cfg.seq_len, nkv, hd), k.dtype),
-            )
-            cached_v = self.variable(
-                "cache", "cached_value",
-                lambda: jnp.zeros((B, cfg.seq_len, nkv, hd), v.dtype),
-            )
-            cache_index = self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-            )
+            paged = pages is not None
+            if paged:
+                pt_sz, pool_sz = kv_layout.page_tokens, kv_layout.pool_pages
+                cached_k = self.variable(
+                    "cache", "cached_key",
+                    lambda: jnp.zeros((pool_sz, pt_sz, nkv, hd), k.dtype),
+                )
+                cached_v = self.variable(
+                    "cache", "cached_value",
+                    lambda: jnp.zeros((pool_sz, pt_sz, nkv, hd), v.dtype),
+                )
+            else:
+                cached_k = self.variable(
+                    "cache", "cached_key",
+                    lambda: jnp.zeros((B, cfg.seq_len, nkv, hd), k.dtype),
+                )
+                cached_v = self.variable(
+                    "cache", "cached_value",
+                    lambda: jnp.zeros((B, cfg.seq_len, nkv, hd), v.dtype),
+                )
+                cache_index = self.variable(
+                    "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+                )
             if is_step:
                 # S == 1: one sampled token; S > 1: batched PREFILL — the
                 # whole prompt in one pass that also fills the cache, so
                 # generation costs 1 forward + (new-1) cached steps instead
                 # of (P + new - 1) sequential steps
-                pos = cache_index.value
+                if paged:
+                    if pos is None:
+                        raise ValueError(
+                            "paged decode needs pos (the pool has no "
+                            "cache_index — write position is per group)"
+                        )
+                    pos = jnp.asarray(pos, jnp.int32)
+                else:
+                    pos = cache_index.value
                 if pad is None:
                     q = apply_rope(q, cos, sin, offset=pos)
                     k = apply_rope(k, cos, sin, offset=pos)
@@ -199,20 +246,43 @@ class Attention(nn.Module):
                     # left-padded rows: cache slot s holds the row's true
                     # position s - pad[b]. Pad slots clamp to 0 — their K/V
                     # never attend (masked below), only the table index
-                    # must stay in range.
+                    # must stay in range. With a shared prefix the row's
+                    # own region starts at prefix_len, so the same formula
+                    # holds (writes only ever target slots >= prefix_len).
                     positions = jnp.maximum(
                         pos + jnp.arange(S)[None, :] - pad[:, None], 0
                     )
                     q = apply_rope_at(q, cos, sin, positions)
                     k = apply_rope_at(k, cos, sin, positions)
-                k_all = jax.lax.dynamic_update_slice(
-                    cached_k.value, k, (0, pos, 0, 0)
-                )
-                v_all = jax.lax.dynamic_update_slice(
-                    cached_v.value, v, (0, pos, 0, 0)
-                )
-                cached_k.value, cached_v.value = k_all, v_all
-                cache_index.value = pos + S
+                if paged:
+                    # scatter this call's S slots through the page table:
+                    # slot s lives at (pages[b, s // pt], s % pt). Rows
+                    # never share their WRITE pages (copy-on-write: shared
+                    # prefix pages sit below pos and are read-only here).
+                    slots = pos + jnp.arange(S)
+                    pp = jnp.take_along_axis(
+                        pages, jnp.broadcast_to((slots // pt_sz)[None, :], (B, S)), axis=1
+                    )
+                    off = jnp.broadcast_to((slots % pt_sz)[None, :], (B, S))
+                    k_all = cached_k.value.at[pp, off].set(k)
+                    v_all = cached_v.value.at[pp, off].set(v)
+                    cached_k.value, cached_v.value = k_all, v_all
+                    win = pages.shape[1] * pt_sz
+                    # gather the row's whole window back out of the pool;
+                    # unallocated tail entries alias a scratch page whose
+                    # garbage is masked dead below (slot > pos + i)
+                    k_all = k_all[pages].reshape(B, win, nkv, hd)
+                    v_all = v_all[pages].reshape(B, win, nkv, hd)
+                else:
+                    k_all = jax.lax.dynamic_update_slice(
+                        cached_k.value, k, (0, pos, 0, 0)
+                    )
+                    v_all = jax.lax.dynamic_update_slice(
+                        cached_v.value, v, (0, pos, 0, 0)
+                    )
+                    cached_k.value, cached_v.value = k_all, v_all
+                    cache_index.value = pos + S
+                    win = cfg.seq_len
                 # Scores straight against the grouped cache: the full-cache
                 # K/V read dominates each decode step, and expanding it
                 # (jnp.repeat) multiplied that read by nh/nkv for identical
@@ -224,22 +294,31 @@ class Attention(nn.Module):
                     q.reshape(B, S, nkv, G, hd),
                     k_all,
                     preferred_element_type=jnp.float32,
-                ).reshape(B, nh, S, cfg.seq_len) / np.sqrt(hd)
+                ).reshape(B, nh, S, win) / np.sqrt(hd)
                 # query row i may see cache positions <= pos + i
                 live = (
-                    jnp.arange(cfg.seq_len)[None, :]
+                    jnp.arange(win)[None, :]
                     <= (pos + jnp.arange(S))[:, None]
                 )
                 mask = live[None, None, :, :]
                 if pad is not None:
-                    # left-pad slots are dead for every query of that row
-                    valid = jnp.arange(cfg.seq_len)[None, :] >= pad[:, None]
+                    if prefix_len:
+                        # row layout: [shared prefix 0..prefix_len) |
+                        # dead left-pad | own tokens]. Prefix slots are
+                        # live for every row; the dead window shifts right.
+                        ar = jnp.arange(win)[None, :]
+                        valid = (ar < prefix_len) | (
+                            ar >= prefix_len + pad[:, None]
+                        )
+                    else:
+                        # left-pad slots are dead for every query of that row
+                        valid = jnp.arange(win)[None, :] >= pad[:, None]
                     mask = mask & valid[:, None, None, :]
                 scores = jnp.where(mask, scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
                 out = jnp.einsum(
                     "bkgqs,bskd->bqkgd",
-                    probs.reshape(B, nkv, G, S, cfg.seq_len),
+                    probs.reshape(B, nkv, G, S, win),
                     v_all,
                 ).reshape(B, S, nh * hd)
                 return _proj(cfg, cfg.dim, "o_proj")(out)
@@ -287,9 +366,14 @@ class Block(nn.Module):
     cfg: TransformerConfig
     train: bool = False
     decode: bool = False
+    # paged-KV statics (ISSUE 6): the pool shape and shared-prefix width
+    # are compile-time, so they ride as module attributes; the traced page
+    # table / write position arrive as call arguments
+    kv_layout: Optional[Any] = None
+    prefix_len: int = 0
 
     @nn.compact
-    def __call__(self, x, pad=None):
+    def __call__(self, x, pad=None, pages=None, pos=None):
         from ..parallel.sharding import constrain
 
         cfg = self.cfg
@@ -299,6 +383,10 @@ class Block(nn.Module):
             train=self.train,
             decode=self.decode,
             pad=pad,
+            pages=pages,
+            pos=pos,
+            kv_layout=self.kv_layout,
+            prefix_len=self.prefix_len,
         )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
@@ -325,18 +413,29 @@ class Block(nn.Module):
 class _ScanBlock(nn.Module):
     """Scan body: (carry, _) → (carry, None) signature nn.scan requires.
 
-    The carry is either the activations alone or, on the left-padded decode
-    path, an (activations, pad) tuple — pad rides in the carry (unchanged by
-    every layer) because a traced array cannot be a module attribute."""
+    The carry is either the activations alone, an (activations, pad) tuple
+    on the left-padded decode path, or (activations, pad, pages, pos) on
+    the paged-KV path — the traced per-row arrays ride in the carry
+    (unchanged by every layer) because a traced array cannot be a module
+    attribute; the static paged knobs are module attributes."""
 
     cfg: TransformerConfig
     train: bool = False
     decode: bool = False
+    kv_layout: Optional[Any] = None
+    prefix_len: int = 0
 
     @nn.compact
     def __call__(self, carry, _):
-        block = Block(self.cfg, self.train, self.decode, name="block")
+        block = Block(
+            self.cfg, self.train, self.decode,
+            kv_layout=self.kv_layout, prefix_len=self.prefix_len,
+            name="block",
+        )
         if isinstance(carry, tuple):
+            if len(carry) == 4:
+                x, pad, pages, pos = carry
+                return (block(x, pad=pad, pages=pages, pos=pos), pad, pages, pos), None
             x, pad = carry
             return (block(x, pad=pad), pad), None
         return block(carry), None
@@ -413,6 +512,10 @@ class Transformer(nn.Module):
         decode: bool = False,
         return_features: bool = False,
         pad=None,  # [B] left-pad widths for bucketed decode (serving path)
+        pages=None,  # [B, n_pages] page table → block-paged KV decode
+        pos=None,  # traced int32 scalar: first cache slot written this call
+        kv_layout=None,  # kv_pages.PagedKVLayout (static pool shape)
+        prefix_len: int = 0,  # static shared-prefix width (paged path)
     ):
         cfg = self.cfg
         if decode and cfg.pipeline_stages > 1:
@@ -426,6 +529,13 @@ class Transformer(nn.Module):
                 "pad (left-pad widths) only applies to the KV-cache decode "
                 "path; training/eval should mask via labels instead"
             )
+        if pages is not None:
+            if not decode:
+                raise ValueError(
+                    "pages (block-paged KV) only applies to the decode path"
+                )
+            if kv_layout is None:
+                raise ValueError("paged decode needs kv_layout (pool shape)")
         embed = nn.Embed(
             cfg.vocab_size,
             cfg.dim,
@@ -452,15 +562,23 @@ class Transformer(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
             )
-            if pad is not None:
-                (x, _), _ = Layers(cfg, train, decode, name="layers")(
-                    (x, pad), None
-                )
+            layers = Layers(
+                cfg, train, decode,
+                kv_layout=kv_layout, prefix_len=prefix_len, name="layers",
+            )
+            if pages is not None:
+                (x, _, _, _), _ = layers((x, pad, pages, pos), None)
+            elif pad is not None:
+                (x, _), _ = layers((x, pad), None)
             else:
-                x, _ = Layers(cfg, train, decode, name="layers")(x, None)
+                x, _ = layers(x, None)
         else:
             for i in range(cfg.n_layers):
-                x = Block(cfg, train, decode, name=f"layer_{i}")(x, pad=pad)
+                x = Block(
+                    cfg, train, decode,
+                    kv_layout=kv_layout, prefix_len=prefix_len,
+                    name=f"layer_{i}",
+                )(x, pad=pad, pages=pages, pos=pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_features:
             # fused-loss path: the caller computes head+loss from features;

@@ -141,11 +141,36 @@ class V1ServingSpec(BaseSchema):
     default_deadline_ms: Optional[float | str] = None
     drain_grace_s: float | str = 5.0
     breaker_threshold: int | str = 5
+    # paged KV cache + streaming (ISSUE 6): kvPoolPages sizes the fixed
+    # block-paged KV pool (None keeps the dense per-group caches);
+    # kvPageTokens is the block granularity, prefixCache enables
+    # cross-request prefix KV reuse, stream exposes /generate?stream=1
+    kv_page_tokens: int | str = 128
+    kv_pool_pages: Optional[int | str] = None
+    prefix_cache: bool = True
+    stream: bool = True
+    stream_chunk_tokens: int | str = 8
 
     @model_validator(mode="after")
     def _check(self):
         if isinstance(self.max_batch, int) and self.max_batch < 1:
             raise ValueError(f"maxBatch must be >= 1, got {self.max_batch}")
+        if isinstance(self.kv_page_tokens, int) and self.kv_page_tokens < 1:
+            raise ValueError(
+                f"kvPageTokens must be >= 1, got {self.kv_page_tokens}"
+            )
+        if isinstance(self.kv_pool_pages, int) and self.kv_pool_pages < 2:
+            raise ValueError(
+                f"kvPoolPages must be >= 2 (1 scratch + data), "
+                f"got {self.kv_pool_pages}"
+            )
+        if (
+            isinstance(self.stream_chunk_tokens, int)
+            and self.stream_chunk_tokens < 1
+        ):
+            raise ValueError(
+                f"streamChunkTokens must be >= 1, got {self.stream_chunk_tokens}"
+            )
         if isinstance(self.max_queue, int) and self.max_queue < 1:
             raise ValueError(f"maxQueue must be >= 1, got {self.max_queue}")
         if isinstance(self.breaker_threshold, int) and self.breaker_threshold < 1:
@@ -195,6 +220,15 @@ class V1ServingSpec(BaseSchema):
             ),
             drain_grace_s=float(self.drain_grace_s),
             breaker_threshold=int(self.breaker_threshold),
+            kv_page_tokens=int(self.kv_page_tokens),
+            kv_pool_pages=(
+                int(self.kv_pool_pages)
+                if self.kv_pool_pages is not None
+                else None
+            ),
+            prefix_cache=self.prefix_cache,
+            stream=self.stream,
+            stream_chunk_tokens=int(self.stream_chunk_tokens),
         )
 
 

@@ -177,6 +177,12 @@ class ServingConfig:
     drain_grace_s: float = 5.0  # stop(): budget to flush in-flight work
     breaker_threshold: int = 5  # consecutive decode failures → open
     breaker_cooldown_s: float = 1.0  # open → half-open probe interval
+    # paged KV cache + streaming (ISSUE 6); kv_pool_pages=None → dense path
+    kv_page_tokens: int = 128
+    kv_pool_pages: Optional[int] = None
+    prefix_cache: bool = True
+    stream: bool = True  # expose POST /generate?stream=1
+    stream_chunk_tokens: int = 8  # decode steps per emitted chunk
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -197,6 +203,9 @@ class GroupKey:
     eos_id: Optional[int]
     num_beams: int = 1
     length_penalty: float = 1.0
+    # paged path: rows in one group share the compiled (L, pb, nb) shape;
+    # prompt_bucket then sizes the SUFFIX (tokens beyond the cached prefix)
+    prefix_len: int = 0
 
 
 @dataclasses.dataclass
@@ -212,10 +221,25 @@ class PendingRequest:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Optional[list] = None  # row token ids on success
     error: Optional[BaseException] = None
+    # paged KV + streaming (ISSUE 6)
+    kv_plan: Optional[object] = None  # serving.kv.RowPlan when paged
+    on_tokens: Optional[object] = None  # callable(list[int]) per decoded chunk
+    on_finish: Optional[object] = None  # callable(req) on ANY terminal path
+    t0: Optional[float] = None  # telemetry clock at admission (TTFT anchor)
+    first_token_at: Optional[float] = None
 
     def finish(self, result=None, error=None):
+        # idempotent: losing racers (deadline sweep vs decode completion)
+        # must not clobber the outcome or re-fire resource release
+        if self.done.is_set():
+            return
         self.result = result
         self.error = error
+        if self.on_finish is not None:
+            try:
+                self.on_finish(self)
+            except Exception:  # noqa: BLE001 — release must not mask result
+                pass
         self.done.set()
 
     def expired(self, now: Optional[float] = None) -> bool:

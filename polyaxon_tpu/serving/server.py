@@ -25,8 +25,15 @@ Endpoints:
             "topK": int?, "eosId": int?, "seed": int?, "deadlineMs": float?,
             "numBeams": int? (beam search when > 1), "lengthPenalty": float?}
      errors: 400 validation; 503 + Retry-After shed (queue full, breaker
-     open, expired at admission, draining — never queued, retry later);
-     504 deadline exceeded while queued (dropped before dispatch).
+     open, expired at admission, KV page pool exhausted, draining — never
+     queued, retry later); 504 deadline exceeded while queued (dropped
+     before dispatch).
+  POST /generate?stream=1 → Server-Sent Events (`data: <json>` frames):
+     {"row": i, "tokens": [...]} per decoded chunk (generated tokens only;
+     prompt + concatenated chunks == the non-streamed row), then
+     {"row": i, "done": true} per row, then {"done": true}. Requires the
+     paged KV pool (serving.kvPoolPages) for incremental delivery;
+     otherwise each row arrives as one terminal chunk.
 
 Design — the serving fast path (serving/batching.py):
 
@@ -80,6 +87,7 @@ from .batching import (
     batch_bucket,
     choose_buckets,
 )
+from .kv import KVCacheManager
 
 
 def _restore_params_subtree(ckpt_dir: str, abstract_params):
@@ -207,6 +215,31 @@ class ModelServer:
             help="Readiness (/readyz): 1 accepting, 0 draining/degraded",
         )
         self._m_ready.set(0)
+        # paged KV + streaming series (ISSUE 6) — registered from startup
+        # (zeros when the pool is off) so the canary's KV gate can scrape
+        # them unconditionally
+        self._m_kv_total = self.telemetry.gauge(
+            "serving.kv_pages_total",
+            help="KV page pool capacity (0 = dense per-group caches)",
+        )
+        self._m_kv_used = self.telemetry.gauge(
+            "serving.kv_pages_used",
+            help="KV pages currently allocated (incl. scratch + prefix cache)",
+        )
+        self._m_prefix_hits = self.telemetry.counter(
+            "serving.prefix_cache_hits",
+            help="Requests whose prompt prefix was served from cached KV",
+        )
+        self._m_prefix_misses = self.telemetry.counter(
+            "serving.prefix_cache_misses",
+            help="Requests that found no cached KV prefix",
+        )
+        self._m_ttft = self.telemetry.histogram(
+            "serving.ttft_ms",
+            buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+            help="Time to first token, milliseconds (admission → first "
+            "sampled token; whole-decode on the dense path)",
+        )
         self._prompt_ladder, self._new_ladder = self.config.ladders(
             int(module.cfg.seq_len)
         )
@@ -228,6 +261,21 @@ class ModelServer:
         self._coalescer: Optional[DecodeCoalescer] = None
         if self.config.batching:
             self._coalescer = self._make_coalescer()
+        # block-paged KV cache (ISSUE 6): one fixed pool replaces the dense
+        # per-group cache allocations; admission reserves pages instead of
+        # worst-case seq_len rows. Only meaningful on the coalesced path.
+        self._kv: Optional[KVCacheManager] = None
+        if self.config.batching and self.config.kv_pool_pages:
+            self._kv = KVCacheManager(
+                module,
+                params,
+                pool_pages=int(self.config.kv_pool_pages),
+                page_tokens=int(self.config.kv_page_tokens),
+                prefix_cache=bool(self.config.prefix_cache),
+                observer=self._kv_observe,
+            )
+            self._m_kv_total.set(self._kv.pool.n_pages)
+            self._m_kv_used.set(self._kv.pool.used)
 
     def _make_coalescer(self) -> DecodeCoalescer:
         breaker = CircuitBreaker(
@@ -236,7 +284,7 @@ class ModelServer:
             on_change=self._m_breaker.set,
         )
         return DecodeCoalescer(
-            self._execute_group,
+            self._dispatch_group,
             max_batch=self.config.max_batch,
             max_wait_ms=self.config.max_wait_ms,
             max_queue=self.config.max_queue,
@@ -264,6 +312,22 @@ class ModelServer:
             self.telemetry.counter(
                 "serving.decode_errors", help="Decode batch failures"
             ).inc()
+
+    def _kv_observe(self, event: str, **ctx) -> None:
+        """KVCacheManager → registry bridge (same pipeline as _observe)."""
+        if event == "kv_pages":
+            self._m_kv_used.set(ctx["used"])
+        elif event == "prefix_hit":
+            self._m_prefix_hits.inc()
+        elif event == "prefix_miss":
+            self._m_prefix_misses.inc()
+        elif event == "prefix_evict":
+            self.telemetry.counter(
+                "serving.prefix_cache_evictions",
+                help="Prefix-cache entries LRU-evicted to admit new requests",
+            ).inc()
+        elif event == "shed":
+            self._observe("shed", **ctx)
 
     @property
     def compile_count(self) -> int:
@@ -551,32 +615,70 @@ class ModelServer:
         sampling)."""
         cfg = self.module.cfg
         out = []
-        for i, row in enumerate(req["arr"]):
-            pb, nb = choose_buckets(
-                len(row),
-                req["max_new"],
-                self._prompt_ladder,
-                self._new_ladder,
-                int(cfg.seq_len),
-            )
-            key = GroupKey(
-                prompt_bucket=pb,
-                new_bucket=nb,
-                temperature=req["temperature"],
-                top_k=req["top_k"],
-                eos_id=req["eos_id"],
-            )
-            out.append(
-                PendingRequest(
+        try:
+            for i, row in enumerate(req["arr"]):
+                plan = None
+                if self._kv is not None:
+                    # paged admission: prefix lookup + suffix bucketing +
+                    # page reservation (may shed with reason "kv_pages")
+                    plan = self._kv.plan_row(
+                        row.tolist(),
+                        req["max_new"],
+                        self._prompt_ladder,
+                        self._new_ladder,
+                        int(cfg.seq_len),
+                    )
+                    pb, nb = plan.suffix_bucket, plan.new_bucket
+                    key = GroupKey(
+                        prompt_bucket=pb,
+                        new_bucket=nb,
+                        temperature=req["temperature"],
+                        top_k=req["top_k"],
+                        eos_id=req["eos_id"],
+                        prefix_len=plan.prefix_len,
+                    )
+                else:
+                    pb, nb = choose_buckets(
+                        len(row),
+                        req["max_new"],
+                        self._prompt_ladder,
+                        self._new_ladder,
+                        int(cfg.seq_len),
+                    )
+                    key = GroupKey(
+                        prompt_bucket=pb,
+                        new_bucket=nb,
+                        temperature=req["temperature"],
+                        top_k=req["top_k"],
+                        eos_id=req["eos_id"],
+                    )
+                r = PendingRequest(
                     tokens=row.tolist(),
                     prompt_len=len(row),
                     max_new=req["max_new"],
                     seed=req["seed"] + i,
                     key=key,
                     deadline=req["deadline"],
+                    kv_plan=plan,
+                    t0=_now(),
                 )
-            )
+                if plan is not None:
+                    # on ANY terminal path (scatter, shed, deadline, crash,
+                    # drain) the row's pages/reservation/prefix refs return
+                    # to the pool — finish() is idempotent, so is release()
+                    r.on_finish = self._release_plan
+                out.append(r)
+        except (ShedError, ServingError):
+            # row k failed admission: rows 0..k-1 already hold reservations
+            for r in out:
+                if r.kv_plan is not None:
+                    self._kv.release(r.kv_plan)
+            raise
         return out
+
+    def _release_plan(self, r: PendingRequest) -> None:
+        if r.kv_plan is not None and self._kv is not None:
+            self._kv.release(r.kv_plan)
 
     # ------------------------------------------------------------ compute
     def _execute_group(self, batch: list[PendingRequest]):
@@ -623,12 +725,191 @@ class ModelServer:
             )
         for i, r in enumerate(batch):
             pad = P - r.prompt_len
+            if r.t0 is not None:
+                # dense path has no incremental emission: TTFT degenerates
+                # to whole-decode latency (the paged path beats this)
+                self._m_ttft.observe((_now() - r.t0) * 1e3)
             # truncate the bucketed tail to what the client asked for — a
             # longer bucket's extra tokens are a strict continuation, so
             # the first max_new are identical to an exact-shape run
             r.finish(
                 result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
             )
+        self._m_requests.inc(n)
+
+    def _paged_prefill_fn(self, bb, pb, prefix_len, n_pages, temperature, top_k):
+        from ..models.generate import jit_paged_prefill
+
+        key = ("paged_prefill", bb, pb, prefix_len, n_pages, temperature, top_k)
+        return self._cached(
+            key,
+            lambda: jit_paged_prefill(
+                self.module,
+                kv_layout=self._kv.layout,
+                prefix_len=prefix_len,
+                temperature=temperature,
+                top_k=top_k,
+            ),
+        )
+
+    def _paged_chunk_fn(
+        self, bb, steps, prefix_len, n_pages, temperature, top_k, eos_id
+    ):
+        from ..models.generate import jit_paged_chunk
+
+        key = (
+            "paged_chunk", bb, steps, prefix_len, n_pages, temperature,
+            top_k, eos_id,
+        )
+        return self._cached(
+            key,
+            lambda: jit_paged_chunk(
+                self.module,
+                steps=steps,
+                kv_layout=self._kv.layout,
+                prefix_len=prefix_len,
+                temperature=temperature,
+                top_k=top_k,
+                eos_id=eos_id,
+            ),
+        )
+
+    def _execute_group_paged(self, batch: list[PendingRequest]):
+        """Paged decode for one coalesced group: prefill the suffixes
+        through the page tables (the shared prefix is already in the
+        pool), then stream sampled tokens out in `stream_chunk_tokens`
+        chunks. Tokens are byte-identical to the dense bucketed path
+        (pinned by tests/test_kv_pages.py); what changes is memory — one
+        fixed pool instead of per-group worst-case caches — and latency
+        shape: the first token leaves after prefill, not after the whole
+        decode. The pool cache buffer is DONATED through every prefill/
+        chunk call, so decode updates it in place."""
+        import time as _time
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        kv = self._kv
+        key = batch[0].key
+        n = len(batch)
+        inject("serving.slow", rows=n)
+        inject("serving.decode", rows=n)
+        qnow = _time.monotonic()
+        for r in batch:
+            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+        self._m_occupancy.observe(n)
+        self._m_batches.inc()
+        L, pb, nb = key.prefix_len, key.prompt_bucket, key.new_bucket
+        pt = kv.layout.page_tokens
+        n_pages = kv.layout.pages_for(L + pb + nb - 1)
+        bb = batch_bucket(n, max(n, self.config.max_batch))
+        plans = [r.kv_plan for r in batch] + [None] * (bb - n)
+        arr = np.zeros((bb, pb), np.int32)
+        pads = np.full((bb,), pb - 1, np.int32)  # dummy rows: length-1 suffix
+        seeds = np.zeros((bb,), np.int32)
+        for i, r in enumerate(batch):
+            sfx = r.tokens[L:]
+            arr[i, pb - len(sfx):] = sfx
+            pads[i] = pb - len(sfx)
+            seeds[i] = r.seed
+        # prefill: writes suffix KV into slots [L, L+pb) of each row's pages
+        kv.ensure_pages(plans[:n], upto_slot=L + pb)
+        tables = kv.tables(plans, bb, n_pages)
+        with self._lock:
+            fn = self._paged_prefill_fn(
+                bb, pb, L, n_pages, key.temperature, key.top_k
+            )
+            kv.cache, first = fn(
+                self.params,
+                kv.cache,
+                jnp.asarray(arr),
+                jnp.asarray(pads),
+                jnp.asarray(tables),
+                jnp.asarray(seeds),
+            )
+        first_np = np.asarray(first)
+        tnow = _now()
+        gen = [[int(first_np[i])] for i in range(n)]
+        for i, r in enumerate(batch):
+            r.first_token_at = tnow
+            if r.t0 is not None:
+                self._m_ttft.observe((tnow - r.t0) * 1e3)
+            if r.on_tokens is not None:
+                try:
+                    r.on_tokens([int(first_np[i])])
+                except Exception:  # noqa: BLE001 — a dead client stays local
+                    pass
+        # chunked decode: fixed-steps compiles, traced pos/start_g
+        tok = first
+        done = jnp.zeros((bb,), bool)
+        pos, g, remaining = L + pb, 1, nb - 1
+        chunk_cap = max(1, int(self.config.stream_chunk_tokens))
+        early_eos = False
+        while remaining > 0:
+            steps = min(chunk_cap, remaining)
+            kv.ensure_pages(plans[:n], upto_slot=pos + steps)
+            tables = kv.tables(plans, bb, n_pages)
+            with self._lock:
+                fn = self._paged_chunk_fn(
+                    bb, steps, L, n_pages, key.temperature, key.top_k,
+                    key.eos_id,
+                )
+                kv.cache, toks, done = fn(
+                    self.params,
+                    kv.cache,
+                    tok,
+                    done,
+                    jnp.asarray(pads),
+                    jnp.asarray(tables),
+                    jnp.asarray(seeds),
+                    jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(g, jnp.int32),
+                )
+            toks_np = np.asarray(toks)
+            for i, r in enumerate(batch):
+                already = len(gen[i])
+                fresh = toks_np[i, : max(0, r.max_new - already)].tolist()
+                gen[i].extend(int(t) for t in fresh)
+                if fresh and r.on_tokens is not None:
+                    try:
+                        r.on_tokens([int(t) for t in fresh])
+                    except Exception:  # noqa: BLE001
+                        pass
+            tok = toks[:, -1]
+            pos += steps
+            g += steps
+            remaining -= steps
+            if key.eos_id is not None and bool(np.asarray(done)[:n].all()):
+                # every real row has latched eos: the remaining samples
+                # would all be pinned to eos_id — emit them host-side
+                early_eos = True
+                break
+        if early_eos:
+            for i, r in enumerate(batch):
+                short = r.max_new - len(gen[i])
+                if short > 0:
+                    fresh = [int(key.eos_id)] * short
+                    gen[i].extend(fresh)
+                    if r.on_tokens is not None:
+                        try:
+                            r.on_tokens(fresh)
+                        except Exception:  # noqa: BLE001
+                            pass
+        # index each row's page-aligned prompt prefix BEFORE finish()
+        # releases the pages — the next request with this prompt prefix
+        # skips its prefill
+        try:
+            with self._lock:  # harvest donates the pool buffer too
+                kv.harvest(
+                    [
+                        (r.tokens, r.kv_plan, int(pads[i]))
+                        for i, r in enumerate(batch)
+                    ]
+                )
+        except Exception:  # noqa: BLE001 — cache warmth must not fail rows
+            pass
+        for i, r in enumerate(batch):
+            r.finish(result=list(r.tokens) + gen[i][: r.max_new])
         self._m_requests.inc(n)
 
     def _execute_beam_group(self, batch: list[PendingRequest]):
@@ -657,6 +938,8 @@ class ModelServer:
     def _dispatch_group(self, batch: list[PendingRequest]):
         if batch[0].key.num_beams > 1:
             self._execute_beam_group(batch)
+        elif self._kv is not None and batch[0].kv_plan is not None:
+            self._execute_group_paged(batch)
         else:
             self._execute_group(batch)
 
@@ -733,9 +1016,14 @@ class ModelServer:
                 self._coalescer.submit(r)
                 submitted.append(r)
         except ShedError:
-            # multi-row body partially admitted: wait out the admitted rows
-            # (they resolve normally, results discarded) then report the
-            # shed — the client retries the whole body
+            # multi-row body partially admitted: the unsubmitted rows give
+            # their page reservations back NOW (nobody will finish them);
+            # then wait out the admitted rows (they resolve normally,
+            # results discarded, on_finish releases their pages) and report
+            # the shed — the client retries the whole body
+            for r in rows:
+                if r not in submitted and r.kv_plan is not None:
+                    self._kv.release(r.kv_plan)
             for r in submitted:
                 r.done.wait(self.config.request_timeout_s)
             raise
@@ -748,6 +1036,88 @@ class ModelServer:
             if r.error is not None:
                 raise r.error
         return {"tokens": [r.result for r in rows]}
+
+    # ----------------------------------------------------------- streaming
+    def stream_request(self, body: dict):
+        """Streaming producer path (`POST /generate?stream=1`): yields one
+        event dict per decoded chunk as the paged decode emits it —
+        `{"row": i, "tokens": [...]}` with newly generated tokens (the
+        client reconstructs the full row as prompt + concatenated chunks,
+        which equals the non-streamed result token for token), then
+        `{"row": i, "done": true}` (or `{"row": i, "error": msg}`) per
+        row, then `{"done": true}`. Admission errors (400/503/504) raise
+        before the first event so the HTTP layer can still set a status
+        code; later failures become in-band error events."""
+        t0 = _now()
+        try:
+            yield from self._stream_request(body)
+        finally:
+            self._m_latency.observe(_now() - t0)
+
+    def _stream_request(self, body: dict):
+        import queue as _queue
+
+        if self._draining:
+            self._observe("shed", reason="draining")
+            raise ServerClosingError("server draining: admission closed")
+        req = self._validate(body)
+        if (
+            self._kv is None
+            or self._coalescer is None
+            or self._coalescer._thread is None
+            or req["num_beams"] > 1
+        ):
+            # no incremental decode on this path: degrade to one terminal
+            # chunk per row (same event shape, no partial delivery)
+            out = self._handle_request(body)
+            for i, row in enumerate(out["tokens"]):
+                yield {"row": i, "tokens": row[len(req["arr"][i]) :]}
+                yield {"row": i, "done": True}
+            yield {"done": True}
+            return
+        rows = self._make_requests(req)
+        events: _queue.Queue = _queue.Queue()
+        for i, r in enumerate(rows):
+            r.on_tokens = (
+                lambda toks, i=i: events.put({"row": i, "tokens": toks})
+            )
+            release = r.on_finish  # _release_plan, set by _make_requests
+
+            def _finished(req_row, i=i, release=release):
+                if release is not None:
+                    release(req_row)
+                events.put(
+                    {"row": i, "done": True}
+                    if req_row.error is None
+                    else {"row": i, "error": str(req_row.error)}
+                )
+
+            r.on_finish = _finished
+        submitted = []
+        try:
+            for r in rows:
+                self._coalescer.submit(r)
+                submitted.append(r)
+        except ShedError:
+            for r in rows:
+                if r not in submitted and r.kv_plan is not None:
+                    self._kv.release(r.kv_plan)
+            for r in submitted:
+                r.done.wait(self.config.request_timeout_s)
+            raise
+        pending = len(rows)
+        while pending:
+            try:
+                ev = events.get(timeout=self.config.request_timeout_s)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"decode did not complete within "
+                    f"{self.config.request_timeout_s:.0f}s"
+                ) from None
+            if "done" in ev or "error" in ev:
+                pending -= 1
+            yield ev
+        yield {"done": True}
 
     # --------------------------------------------------------- readiness
     def readiness(self) -> tuple[bool, str]:
@@ -802,7 +1172,19 @@ class ModelServer:
             }
         lat = self._m_latency.summary()
         queue = self._m_queue_wait.summary()
+        kv = {"enabled": False}
+        if self._kv is not None:
+            ttft = self._m_ttft.summary()
+            kv = {
+                "enabled": True,
+                **self._kv.stats(),
+                "ttft_ms": {
+                    k: round(ttft[k], 3) if ttft[k] is not None else None
+                    for k in ("p50", "p95", "p99", "mean")
+                },
+            }
         return {
+            "kv": kv,
             **resilience,
             "batching": bool(self.config.batching),
             "compile_count": self.compile_count,
@@ -884,14 +1266,54 @@ class ModelServer:
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
+            def _stream(self, body):
+                """SSE response: one `data: <json>` frame per event from
+                stream_request(). The first event is pulled BEFORE headers
+                go out so admission failures still map to real status
+                codes; mid-stream failures become an in-band error frame
+                (the 200 is already on the wire)."""
+                gen = server.stream_request(body)
+                first = next(gen)  # admission errors raise here
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-store")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                import itertools
+
+                try:
+                    for ev in itertools.chain((first,), gen):
+                        self.wfile.write(
+                            b"data: " + json.dumps(ev).encode() + b"\n\n"
+                        )
+                        self.wfile.flush()
+                except BrokenPipeError:
+                    # client went away mid-stream; decode finishes on its
+                    # own and the rows release their pages via on_finish
+                    pass
+                except Exception as e:  # noqa: BLE001 — in-band, then close
+                    try:
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps({"error": str(e)}).encode()
+                            + b"\n\n"
+                        )
+                    except OSError:
+                        pass
+
             def do_POST(self):
-                if self.path != "/generate":
+                path, _, query = self.path.partition("?")
+                if path != "/generate":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
+                want_stream = "stream=1" in query.split("&")
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
-                    self._send(200, server.handle_request(body))
+                    if want_stream and server.config.stream:
+                        self._stream(body)
+                    else:
+                        self._send(200, server.handle_request(body))
                 except ShedError as e:
                     # shed at admission: never queued, safe to retry later
                     self._send(

@@ -22,6 +22,15 @@ Third rule: ONE deadline clock in serving. Deadline math in
 deadline jumps with NTP steps and DST, silently shedding live requests
 (or keeping dead ones), so raw `time.time()` is forbidden there.
 
+Fourth rule: NO clock at all in page-pool accounting. The paged-KV
+modules (`polyaxon_tpu/models/kv_pages.py`, `polyaxon_tpu/serving/kv.py`)
+order LRU eviction by a logical tick and observe durations (TTFT) only
+through the telemetry clock helpers in the server layer — a raw
+`time.*()` read inside the pool accounting would couple eviction order
+and occupancy math to the host clock, making paged-vs-dense replay
+nondeterministic and TTFT double-clocked. Any `time.time/monotonic/
+perf_counter` (and `_ns` variants) there is forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -38,6 +47,13 @@ from pathlib import Path
 PATTERN = re.compile(r"\bperf_counter\b")
 SCHED_PATTERN = re.compile(r"\btime\.(?:time|monotonic)\s*\(")
 SERVING_PATTERN = re.compile(r"\btime\.time\s*\(")
+KV_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter)(?:_ns)?\s*\("
+)
+KV_MODULES = (
+    ("polyaxon_tpu", "models", "kv_pages.py"),
+    ("polyaxon_tpu", "serving", "kv.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -50,6 +66,7 @@ def violations(repo_root: Path) -> list[str]:
         in_scheduler = rel.parts[:2] == ("polyaxon_tpu", "scheduler")
         clock_exempt = in_scheduler and rel.name == "clock.py"
         in_serving = rel.parts[:2] == ("polyaxon_tpu", "serving")
+        in_kv = rel.parts in KV_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -65,6 +82,12 @@ def violations(repo_root: Path) -> list[str]:
                 out.append(
                     f"{rel}:{i}: time.time() in serving/ — deadlines "
                     f"must use time.monotonic(): {line.strip()}"
+                )
+            if in_kv and KV_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in page-pool accounting — "
+                    f"use a logical tick or the telemetry clock "
+                    f"helpers: {line.strip()}"
                 )
     return out
 

@@ -194,6 +194,82 @@ PY
       fi
     done
     echo "overload smoke: ok $(date -u +%T)" >> "$log"
+    # paged-KV gate: drive warm traffic (same prompt twice -> prefix
+    # reuse) plus a streamed request through a pool-backed server and
+    # require the KV/TTFT series on /metricsz. A paged deployment whose
+    # pool occupancy, prefix hit rate, or TTFT is dark cannot be
+    # capacity-planned, so a missing series FAILS the canary.
+    echo "running kv metricsz smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+server = ModelServer(
+    b.module, params,
+    config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                         kv_pool_pages=64, kv_page_tokens=8,
+                         stream_chunk_tokens=4),
+)
+port = server.start(port=0)
+try:
+    body = json.dumps({
+        "tokens": [list(range(1, 21))], "maxNewTokens": 6,
+        "temperature": 0.5, "topK": 10, "seed": 0,
+    }).encode()
+    for path in ("/generate", "/generate", "/generate?stream=1"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=300).read()
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statsz", timeout=30
+    ).read())
+finally:
+    server.stop()
+with open("tpu_results/kv_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "serving_kv_pages_total",
+    "serving_kv_pages_used",
+    "serving_prefix_cache_hits_total",
+    "serving_prefix_cache_misses_total",
+    "serving_ttft_ms",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("kv metricsz smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+kv = stats["kv"]
+if kv["prefix"]["hits"] < 1:
+    print("kv metricsz smoke: warm re-post produced no prefix hit", kv)
+    sys.exit(1)
+print(f"kv metricsz smoke: ok ({len(required)} required series present, "
+      f"{kv['prefix']['hits']} prefix hits)")
+PY
+    then
+      echo "KV-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

@@ -1,0 +1,377 @@
+"""Block-paged KV cache (ISSUE 6), tested at three levels:
+
+  * pure units — page math, the rolling content hash, PagePool
+    refcount/reservation invariants, and PrefixCache lookup/insert/LRU
+    eviction/collision handling (no jax);
+  * manager accounting — KVCacheManager admission (reserve → shed with
+    reason kv_pages), lazy allocation, idempotent release, harvest
+    indexing, and the occupancy win over dense worst-case reservation;
+  * model level — paged decode through page tables must be byte-identical
+    to the dense bucketed generate() path, including shared-prefix rows,
+    eos latching, and chunked decode with traced positions.
+"""
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models.kv_pages import (
+    DEFAULT_PAGE_TOKENS,
+    PagedKVLayout,
+    PagePool,
+    PagePoolExhausted,
+    PrefixCache,
+    page_hashes,
+)
+
+pytestmark = pytest.mark.serving
+
+
+# ------------------------------------------------------------ page math
+def test_layout_pages_for():
+    lay = PagedKVLayout(page_tokens=8, pool_pages=4)
+    assert lay.pages_for(0) == 0
+    assert lay.pages_for(1) == 1
+    assert lay.pages_for(8) == 1
+    assert lay.pages_for(9) == 2
+    assert DEFAULT_PAGE_TOKENS == 128
+    with pytest.raises(ValueError):
+        PagedKVLayout(page_tokens=0)
+
+
+def test_page_hashes_chain():
+    toks = list(range(20))
+    h = page_hashes(toks, 8)
+    assert len(h) == 2  # only FULL pages are addressable
+    # chaining: entry k commits to the whole prefix, so a change in page
+    # 0 changes page 1's hash too
+    toks2 = [99] + toks[1:]
+    h2 = page_hashes(toks2, 8)
+    assert h[0] != h2[0] and h[1] != h2[1]
+    # and identical prefixes agree regardless of the tail
+    assert page_hashes(toks[:16], 8) == h
+
+
+# ------------------------------------------------------------- page pool
+def test_pool_refcount_lifecycle():
+    pool = PagePool(4, 8)
+    a = pool.alloc(2)
+    assert pool.used == 2 and pool.free_pages == 2
+    pool.ref(a)  # second holder
+    pool.unref(a)
+    assert pool.used == 2  # first holder still live
+    pool.unref(a)
+    assert pool.used == 0
+    with pytest.raises(ValueError):
+        pool.unref(a)  # unref of unallocated page
+
+
+def test_pool_reservation_invariant():
+    pool = PagePool(4, 8)
+    pool.reserve(3)
+    assert pool.available == 1
+    with pytest.raises(PagePoolExhausted):
+        pool.reserve(2)
+    # unreserved alloc must not eat the reservation
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc(2)
+    got = pool.alloc(3, reserved=True)
+    assert len(got) == 3 and pool.reserved == 0
+    pool.unreserve(0)
+    with pytest.raises(ValueError):
+        pool.unreserve(1)  # nothing left reserved
+
+
+# ---------------------------------------------------------- prefix cache
+def _cache(pool_pages=16, pt=4, **kw):
+    pool = PagePool(pool_pages, pt)
+    return pool, PrefixCache(pool, **kw)
+
+
+def test_prefix_insert_lookup_release():
+    pool, pc = _cache()
+    toks = list(range(8))  # 2 full pages of 4
+    pages = pool.alloc(2)
+    # index every chain link, as the manager's harvest does, so partial
+    # overlaps can hit
+    assert pc.insert(toks[:4], pages[:1])
+    assert pc.insert(toks, pages)
+    assert pc.insert(toks, pages) is False  # already indexed
+    pool.unref(pages)  # the entries hold their own refs
+    assert pool.used == 2
+    plen, got, entry = pc.lookup(toks + [77, 78])
+    assert plen == 8 and list(got) == pages and entry is not None
+    pc.release(entry, got)
+    # cap: a lookup may not consume the whole prompt (prefill needs >= 1
+    # suffix token to produce logits) — the shorter chain link hits
+    plen, got, entry = pc.lookup(toks, max_tokens=len(toks) - 1)
+    assert plen == 4 and list(got) == pages[:1]
+    pc.release(entry, got)
+    assert pc.hits == 2 and pool.used == 2
+
+
+def test_prefix_lru_eviction_skips_active():
+    pool, pc = _cache(pool_pages=8, pt=4)
+    a, b = list(range(4)), list(range(10, 14))
+    pa, pb = pool.alloc(1), pool.alloc(1)
+    assert pc.insert(a, pa) and pc.insert(b, pb)
+    pool.unref(pa), pool.unref(pb)
+    # a is older (LRU victim) — but an active lookup pins it
+    plen, got, ea = pc.lookup(a + [99])
+    assert plen == 4
+    assert pc.evict_for(8) is False  # only b evictable: 7 of 8 available
+    assert pc.contains(a) and not pc.contains(b)
+    pc.release(ea, got)
+    assert pc.evict_for(8)
+    assert len(pc) == 0 and pool.used == 0
+    assert pc.evictions == 2
+
+
+def test_prefix_hash_collision_first_writer_wins():
+    # adversarial hash: everything collides
+    pool, pc = _cache(hash_fn=lambda prev, chunk: "same")
+    a, b = list(range(4)), list(range(20, 24))
+    pa = pool.alloc(1)
+    assert pc.insert(a, pa)
+    pb = pool.alloc(1)
+    assert pc.insert(b, pb) is False  # slot taken by different content
+    pool.unref(pa), pool.unref(pb)
+    # lookup verifies token content: b degrades to a miss, not a wrong hit
+    plen, _, entry = pc.lookup(b + [1])
+    assert plen == 0 and entry is None
+    assert pc.collisions >= 1
+    plen, got, entry = pc.lookup(a + [1])
+    assert plen == 4
+    pc.release(entry, got)
+
+
+# ------------------------------------------------------ manager accounting
+def _tiny(scan_layers=False):
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import build_model
+
+    cfg = {
+        "preset": "tiny", "seq_len": 64, "n_layers": 2, "dim": 64,
+        "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128,
+        "scan_layers": scan_layers,
+    }
+    b = build_model("transformer_lm", cfg)
+    params = b.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )["params"]
+    return b.module, params
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    return _tiny()
+
+
+def _mgr(tiny_model, pool_pages=16, pt=8, **kw):
+    from polyaxon_tpu.serving.kv import KVCacheManager
+
+    module, params = tiny_model
+    return KVCacheManager(
+        module, params, pool_pages=pool_pages, page_tokens=pt, **kw
+    )
+
+
+PL, NL = (8, 16, 32), (4, 8)
+
+
+def test_manager_reserve_alloc_release(tiny_model):
+    kv = _mgr(tiny_model)
+    plan = kv.plan_row(list(range(1, 13)), 4, PL, NL, 64)
+    # 12 tokens -> suffix bucket 16, new bucket 4 -> pages_for(19) = 3
+    assert (plan.suffix_bucket, plan.new_bucket, plan.n_pages) == (16, 4, 3)
+    assert kv.pool.reserved == 3
+    kv.ensure_pages([plan], upto_slot=16)
+    assert len(plan.own_pages) == 2 and plan.reserved == 1
+    t = kv.tables([plan, None], 2, 3)
+    assert t.shape == (2, 3)
+    assert t[0, 2] == kv.scratch and (t[1] == kv.scratch).all()
+    kv.release(plan)
+    kv.release(plan)  # idempotent
+    assert kv.pool.reserved == 0 and kv.pool.used == 1  # scratch only
+    assert kv.active_rows == 0
+
+
+def test_manager_exhaustion_sheds_with_reason(tiny_model):
+    from polyaxon_tpu.serving.batching import ShedError
+
+    kv = _mgr(tiny_model, pool_pages=6)  # scratch + 5 usable
+    p1 = kv.plan_row(list(range(1, 9)), 4, PL, NL, 64)  # 2 pages
+    p2 = kv.plan_row(list(range(20, 28)), 4, PL, NL, 64)  # 2 pages
+    with pytest.raises(ShedError) as ei:
+        kv.plan_row(list(range(40, 48)), 4, PL, NL, 64)
+    assert ei.value.reason == "kv_pages"
+    kv.release(p1)
+    p3 = kv.plan_row(list(range(40, 48)), 4, PL, NL, 64)  # fits again
+    kv.release(p2), kv.release(p3)
+    assert kv.active_rows == 0 and kv.pool.reserved == 0
+
+
+def test_manager_never_fits_is_client_error(tiny_model):
+    from polyaxon_tpu.serving.batching import ServingError, ShedError
+
+    kv = _mgr(tiny_model, pool_pages=3)
+    with pytest.raises(ServingError) as ei:
+        kv.plan_row(list(range(1, 40)), 8, PL, NL, 64)
+    assert not isinstance(ei.value, ShedError)  # 400, not 503
+    assert kv.active_rows == 0
+
+
+def test_paged_occupancy_beats_dense_reservation(tiny_model):
+    """The acceptance claim: at the same memory budget, page-grained
+    admission holds strictly more concurrent rows than dense worst-case
+    reservation (seq_len slots per row)."""
+    kv = _mgr(tiny_model, pool_pages=16, pt=8)  # 128 slots
+    assert kv.dense_equivalent_rows == 2  # 128 // seq_len 64
+    plans = []
+    for i in range(7):  # 8-token prompts + 4 new -> 2 pages per row
+        plans.append(
+            kv.plan_row([1 + i] * 8, 4, PL, NL, 64)
+        )
+    assert kv.active_rows == 7 > kv.dense_equivalent_rows
+    assert kv.stats()["active_rows_hwm"] == 7
+    for p in plans:
+        kv.release(p)
+
+
+def test_manager_harvest_indexes_prefix(tiny_model):
+    kv = _mgr(tiny_model, pool_pages=32, pt=8)
+    toks = list(range(1, 23))  # 22 tokens = 2 full pages + tail
+    plan = kv.plan_row(toks, 4, PL, NL, 64)
+    kv.ensure_pages([plan], upto_slot=plan.suffix_bucket + plan.new_bucket - 1)
+    pad = plan.suffix_bucket - len(toks)
+    assert kv.harvest([(toks, plan, pad)]) == 2  # both chain links indexed
+    kv.release(plan)
+    # a second request sharing the 16-token prefix hits; pages survive the
+    # releasing row because the entries hold their own refs
+    p2 = kv.plan_row(toks[:16] + [99, 98], 4, PL, NL, 64)
+    assert p2.prefix_len == 16 and p2.prefix_pages_n == 2
+    assert kv.prefix.hits == 1
+    kv.release(p2)
+    assert kv.active_rows == 0 and kv.pool.reserved == 0
+
+
+# ------------------------------------------------- model-level byte identity
+def _identity_case(scan_layers, pb, nb, pt, chunk, prefix_len, temp, eos):
+    """Dense bucketed generate() vs paged prefill+chunks: every generated
+    token must match bit for bit, including a shared prefix prefilled in a
+    SEPARATE pass (the cross-request reuse shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models.generate import (
+        generate,
+        jit_paged_chunk,
+        jit_paged_prefill,
+        make_paged_cache,
+    )
+
+    module, params = _tiny(scan_layers)
+    B = 3
+    rng = np.random.RandomState(1)
+    shared = rng.randint(1, 100, size=prefix_len).tolist()
+    sfx_lens = [max(1, pb - 3), pb, max(1, pb // 2)]
+    prompts = [shared + rng.randint(1, 100, size=s).tolist() for s in sfx_lens]
+    seeds = np.array([7, 11, 13], np.int32)
+
+    # dense reference: full prompts left-padded to prefix_len + pb
+    P = prefix_len + pb
+    arr = np.zeros((B, P), np.int32)
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        arr[i, P - len(p):] = p
+    dense = np.asarray(generate(
+        module, params, jnp.asarray(arr), max_new_tokens=nb,
+        temperature=temp, top_k=40, eos_id=eos, seed=jnp.asarray(seeds),
+        prompt_lengths=jnp.asarray(lens),
+    ))
+    dense_gen = [
+        dense[i, P - lens[i]:][lens[i]:lens[i] + nb] for i in range(B)
+    ]
+
+    # paged: shared prefix prefilled ONCE, rows alias its pages read-only
+    layout = PagedKVLayout(page_tokens=pt, pool_pages=64)
+    cache = make_paged_cache(module, params, layout)
+    n_pages = -(-(prefix_len + pb + nb) // pt)
+    L_pages = prefix_len // pt
+    prefix_ids = list(range(1, 1 + L_pages))
+    nxt = 1 + L_pages
+    tables = np.zeros((B, n_pages), np.int32)
+    for i in range(B):
+        own = list(range(nxt, nxt + n_pages - L_pages))
+        nxt += len(own)
+        tables[i] = prefix_ids + own
+    if prefix_len:
+        pf0 = jit_paged_prefill(module, kv_layout=layout, prefix_len=0,
+                                temperature=temp, top_k=40)
+        cache, _ = pf0(
+            params, cache, jnp.asarray(np.array([shared], np.int32)),
+            jnp.zeros((1,), jnp.int32),
+            jnp.asarray(np.array([prefix_ids], np.int32)),
+            jnp.zeros((1,), jnp.int32),
+        )
+    sfx = np.zeros((B, pb), np.int32)
+    pads = np.zeros((B,), np.int32)
+    for i, p in enumerate(prompts):
+        s = p[prefix_len:]
+        sfx[i, pb - len(s):] = s
+        pads[i] = pb - len(s)
+    pf = jit_paged_prefill(module, kv_layout=layout, prefix_len=prefix_len,
+                           temperature=temp, top_k=40)
+    cache, first = pf(params, cache, jnp.asarray(sfx), jnp.asarray(pads),
+                      jnp.asarray(tables), jnp.asarray(seeds))
+    out = [np.asarray(first).reshape(B, 1)]
+    tok, done = first, jnp.zeros((B,), bool)
+    pos, g, left = prefix_len + pb, 1, nb - 1
+    while left > 0:
+        C = min(chunk, left)
+        cf = jit_paged_chunk(module, steps=C, kv_layout=layout,
+                             prefix_len=prefix_len, temperature=temp,
+                             top_k=40, eos_id=eos)
+        cache, toks, done = cf(
+            params, cache, tok, done, jnp.asarray(pads),
+            jnp.asarray(tables), jnp.asarray(seeds),
+            jnp.asarray(pos, jnp.int32), jnp.asarray(g, jnp.int32),
+        )
+        toks = np.asarray(toks)
+        out.append(toks)
+        tok = jnp.asarray(toks[:, -1])
+        pos, g, left = pos + C, g + C, left - C
+    paged_gen = np.concatenate(out, axis=1)
+    for i in range(B):
+        assert np.array_equal(dense_gen[i], paged_gen[i]), (
+            i, dense_gen[i].tolist(), paged_gen[i].tolist()
+        )
+
+
+def test_paged_decode_identity_with_shared_prefix():
+    # the load-bearing shape: shared prefix from a separate prefill pass,
+    # odd chunking, eos latching, sampled (not greedy) rows
+    _identity_case(
+        scan_layers=False, pb=8, nb=8, pt=4, chunk=3, prefix_len=8,
+        temp=0.8, eos=5,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "scan_layers,pb,nb,pt,chunk,prefix_len,temp,eos",
+    [
+        (False, 8, 8, 4, 3, 0, 0.8, 5),
+        (True, 8, 8, 4, 3, 0, 0.8, 5),
+        (True, 16, 8, 8, 8, 8, 0.8, 5),
+        (False, 8, 5, 16, 2, 0, 0.0, None),  # greedy, page > window
+        (False, 8, 8, 4, 4, 12, 0.8, 2),  # aggressive eos
+    ],
+)
+def test_paged_decode_identity_ladder(
+    scan_layers, pb, nb, pt, chunk, prefix_len, temp, eos
+):
+    _identity_case(scan_layers, pb, nb, pt, chunk, prefix_len, temp, eos)
