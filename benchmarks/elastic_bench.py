@@ -1,0 +1,265 @@
+"""Elastic-training benchmark: checkpoint stall, time-to-resume, and
+steps lost per preemption — the three costs ISSUE 7's tiers + gang
+resize are supposed to bound.
+
+  python benchmarks/elastic_bench.py             # full seeded sweep
+  python benchmarks/elastic_bench.py --smoke     # tier-1 quick pass
+  python benchmarks/elastic_bench.py --seeds 5 --steps 16
+
+Three measurements, one JSON line each (schema pinned by
+tests/test_benchmarks.py):
+
+- **checkpoint_stall_ms** — a clean run with two-tier checkpointing; the
+  stall is read from the trainer's `trainer.checkpoint_stall_ms`
+  histogram (the span around the async save call), NOT a second clock,
+  so the benchmark reports exactly what /metricsz exports.
+
+- **steps_lost_per_preemption** — seeded `kill_mid_run` scenarios (a
+  kill checkpoints nothing, unlike cooperative eviction): lost work per
+  death is `kill_step - resumed_step`, which multi-tier boundary saves
+  bound by `checkpoint_every`. Time-to-resume is the wall time from the
+  RETRYING transition to the `resumed` event (backoff excluded by
+  zeroing the retry delay).
+
+- **elastic_resize** — the shrink→grow round trip through the REAL
+  admission stack under SimClock: a full-fleet elastic job yields to a
+  higher-priority arrival by shrinking instead of waiting, then grows
+  back when the chips free. Reports grant history, queue-wait total
+  (must be 0: the ladder never parks), and makespan versus a rigid run
+  that would have waited.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _train_op(name: str, *, steps: int, checkpoint_every: int,
+              local_dir: str, max_retries: int = 0, backoff: float = 0.0):
+    from polyaxon_tpu.schemas.operation import V1Operation
+
+    return V1Operation.model_validate(
+        {
+            "kind": "operation",
+            "name": name,
+            "component": {
+                "kind": "component",
+                "name": "c",
+                "termination": {
+                    "maxRetries": max_retries,
+                    "backoff": backoff,
+                    "jitter": 0,
+                },
+                "run": {
+                    "kind": "jaxjob",
+                    "program": {
+                        "model": {
+                            "name": "mlp",
+                            "config": {
+                                "input_dim": 8,
+                                "num_classes": 2,
+                                "hidden": [8],
+                            },
+                        },
+                        "data": {
+                            "name": "synthetic",
+                            "batchSize": 8,
+                            "config": {"shape": [8], "num_classes": 2},
+                        },
+                        "optimizer": {"name": "sgd", "learningRate": 0.01},
+                        "train": {
+                            "steps": steps,
+                            "logEvery": 1,
+                            "precision": "float32",
+                            "checkpointEvery": checkpoint_every,
+                            "checkpointLocalDir": local_dir,
+                        },
+                    },
+                },
+            },
+        }
+    )
+
+
+def _execute(op, home: str):
+    from polyaxon_tpu.compiler import compile_operation
+    from polyaxon_tpu.runtime import Executor
+    from polyaxon_tpu.store import RunStore
+
+    store = RunStore(home)
+    compiled = compile_operation(op)
+    status = Executor(store, devices=None).execute(compiled)
+    return store, compiled.run_uuid, getattr(status, "value", str(status))
+
+
+def bench_checkpoint_stall(steps: int, checkpoint_every: int) -> dict:
+    """A clean two-tier run; the stall histogram is the evidence that the
+    async save + background upload keep the step loop moving."""
+    from polyaxon_tpu.telemetry import get_registry
+
+    home = tempfile.mkdtemp(prefix="elastic-bench-")
+    local = tempfile.mkdtemp(prefix="elastic-bench-fast-")
+    try:
+        op = _train_op("stall", steps=steps,
+                       checkpoint_every=checkpoint_every, local_dir=local)
+        _store, _uuid, status = _execute(op, home)
+        hist = get_registry().histogram("trainer.checkpoint_stall_ms")
+        tier_writes = get_registry().counter("checkpoint.tier_writes").value
+        summary = hist.summary()
+        return {
+            "metric": "checkpoint_stall_ms",
+            "status": status,
+            "boundaries": hist.count,
+            "stall_p50_ms": summary["p50"],
+            "stall_p95_ms": summary["p95"],
+            "stall_max_ms": summary["max"],
+            "tier_writes": tier_writes,
+        }
+    finally:
+        shutil.rmtree(home, ignore_errors=True)
+        shutil.rmtree(local, ignore_errors=True)
+
+
+def bench_steps_lost(seeds: list[int], steps: int,
+                     checkpoint_every: int) -> dict:
+    """Seeded kills (the worst case: nothing is flushed on the way down).
+    Lost steps per death must stay <= checkpoint_every; time-to-resume is
+    the RETRYING→resumed wall time."""
+    from polyaxon_tpu import chaos
+    from polyaxon_tpu.chaos import FaultPlan
+
+    lost: list[int] = []
+    resume_ms: list[float] = []
+    for seed in seeds:
+        plan = FaultPlan.kill_mid_run(
+            seed, steps=steps, min_step=checkpoint_every
+        )
+        home = tempfile.mkdtemp(prefix="elastic-bench-")
+        local = tempfile.mkdtemp(prefix="elastic-bench-fast-")
+        try:
+            op = _train_op(
+                f"kill-{seed}", steps=steps,
+                checkpoint_every=checkpoint_every, local_dir=local,
+                max_retries=1,
+            )
+            with chaos.active(plan):
+                store, uuid, status = _execute(op, home)
+            if status != "succeeded":
+                return {"metric": "steps_lost_per_preemption",
+                        "error": f"seed {seed} ended {status}"}
+            resumed = [
+                e for e in store.read_events(uuid) if e["kind"] == "resumed"
+            ]
+            resumed_step = resumed[0]["step"] if resumed else 0
+            resumed_ts = resumed[0]["ts"] if resumed else None
+            lost.append(plan.params["kill_step"] - resumed_step)
+            retrying = [
+                c for c in store.get_status(uuid)["conditions"]
+                if c["type"] == "retrying"
+            ]
+            if retrying and resumed_ts is not None:
+                resume_ms.append(
+                    max(0.0, (resumed_ts - retrying[0]["ts"]) * 1000.0)
+                )
+        finally:
+            shutil.rmtree(home, ignore_errors=True)
+            shutil.rmtree(local, ignore_errors=True)
+    n = len(resume_ms)
+    return {
+        "metric": "steps_lost_per_preemption",
+        "preemptions": len(lost),
+        "checkpoint_every": checkpoint_every,
+        "steps_lost_mean": sum(lost) / len(lost) if lost else None,
+        "steps_lost_max": max(lost) if lost else None,
+        "bound_held": bool(lost) and max(lost) <= checkpoint_every,
+        "time_to_resume_ms_mean": (sum(resume_ms) / n) if n else None,
+        "time_to_resume_ms_max": max(resume_ms) if n else None,
+    }
+
+
+def bench_elastic_resize(duration: float = 8.0) -> dict:
+    """Deterministic shrink→grow round trip in sim time: quantifies what
+    the halving ladder buys over parking in WAIT."""
+    from polyaxon_tpu.scheduler.sim import FleetSimulator, SimJob
+
+    def scenario():
+        elastic = SimJob("elastic", duration=duration, arrival=0.0,
+                         chips=4, min_chips=1)
+        rigid = SimJob("rigid", duration=duration / 2, arrival=2.0,
+                       chips=2, priority=1)
+        return elastic, rigid
+
+    elastic, rigid = scenario()
+    sim = FleetSimulator([elastic, rigid], chips=4,
+                         invariant_fn=lambda s: s.check_invariants())
+    try:
+        report = sim.run()
+    finally:
+        shutil.rmtree(sim.home, ignore_errors=True)
+
+    # counterfactual: the same workload with a RIGID victim — after the
+    # eviction it parks in WAIT until the whole block frees
+    victim, arrival = scenario()
+    victim.min_chips = None
+    rigid_sim = FleetSimulator([victim, arrival], chips=4)
+    try:
+        rigid_report = rigid_sim.run()
+    finally:
+        shutil.rmtree(rigid_sim.home, ignore_errors=True)
+
+    return {
+        "metric": "elastic_resize",
+        "grants": elastic.grants,
+        "resizes": report["elastic_resizes"],
+        "preemptions": elastic.preemptions,
+        "elastic_wait_total_s": sum(elastic.waits),
+        "elastic_makespan_s": report["makespan_s"],
+        "rigid_makespan_s": rigid_report["makespan_s"],
+        "rigid_wait_total_s": sum(victim.waits),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seeds", type=int, default=3,
+                   help="number of seeded kill scenarios")
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--checkpoint-every", type=int, default=2)
+    p.add_argument("--smoke", action="store_true",
+                   help="small deterministic pass for tier-1 CI")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.seeds, args.steps = 1, 6
+
+    records = [
+        bench_checkpoint_stall(args.steps, args.checkpoint_every),
+        bench_steps_lost(list(range(args.seeds)), args.steps,
+                         args.checkpoint_every),
+        bench_elastic_resize(),
+    ]
+    ok = True
+    for r in records:
+        print(json.dumps(r, sort_keys=True))
+        if "error" in r:
+            ok = False
+    lost = next(r for r in records
+                if r["metric"] == "steps_lost_per_preemption")
+    if "error" not in lost and not lost["bound_held"]:
+        print("FAIL: steps lost exceeded checkpoint_every", file=sys.stderr)
+        ok = False
+    resize = next(r for r in records if r["metric"] == "elastic_resize")
+    if resize["elastic_wait_total_s"] != 0.0:
+        print("FAIL: elastic run parked in WAIT", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

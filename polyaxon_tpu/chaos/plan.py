@@ -141,6 +141,69 @@ class FaultPlan:
                     "fallback_step": c - checkpoint_every},
         )
 
+    # ------------------------------------------- elastic/tier scenarios
+    # `checkpoint.upload` fires inside CheckpointTiers._replicate between
+    # the fsynced staging copy and the publishing rename: a "kill" there
+    # dies with the durable tier one step behind the local tier; a "raise"
+    # is a durable-tier outage the run rides out on the local tier.
+
+    @classmethod
+    def preempt_at_peak(
+        cls, seed: int, steps: int, checkpoint_every: int
+    ) -> "FaultPlan":
+        """Scheduler eviction at PEAK lost work: the preemption notice
+        lands on a seed-chosen step in the window just before a boundary
+        save, so the steps since the last checkpoint are the most that can
+        be lost — the bound the acceptance pins is `<= checkpoint_every`."""
+        rng = random.Random(f"preempt_at_peak:{seed}")
+        boundaries = list(range(2 * checkpoint_every, steps, checkpoint_every))
+        b = rng.choice(boundaries)
+        k = b - 1  # last step before the boundary: maximal uncheckpointed work
+        return cls(
+            [Fault("trainer.step", "sigterm", step=k)],
+            seed=seed,
+            params={
+                "preempt_step": k,
+                "last_boundary": b - checkpoint_every,
+            },
+        )
+
+    @classmethod
+    def kill_mid_upload(cls, seed: int, steps: int, checkpoint_every: int) -> "FaultPlan":
+        """The process dies DURING a durable-tier upload (after the staging
+        copy, before the publishing rename) of a seed-chosen boundary step:
+        the durable tier never lists that step, the local tier has it — the
+        restart must resume from the local copy with no lost boundary."""
+        rng = random.Random(f"kill_mid_upload:{seed}")
+        boundaries = list(range(checkpoint_every, steps, checkpoint_every))
+        c = rng.choice(boundaries)
+        return cls(
+            [Fault("checkpoint.upload", "kill", step=c,
+                   message=f"chaos: killed uploading step {c}")],
+            seed=seed,
+            params={"upload_step": c},
+        )
+
+    @classmethod
+    def durable_tier_outage(
+        cls, seed: int, steps: int, checkpoint_every: int, fails: int = 2
+    ) -> "FaultPlan":
+        """The durable tier refuses `fails` consecutive uploads starting at
+        a seed-chosen boundary: the affected steps stay local-only and
+        training never notices (upload faults are counted, not fatal)."""
+        rng = random.Random(f"durable_tier_outage:{seed}")
+        boundaries = list(range(checkpoint_every, steps, checkpoint_every))
+        start = rng.randrange(0, max(1, len(boundaries) - fails + 1))
+        return cls(
+            [Fault("checkpoint.upload", "raise", at=start, count=fails,
+                   message="chaos: durable tier unavailable")],
+            seed=seed,
+            params={
+                "outage_steps": boundaries[start:start + fails],
+                "outage_len": fails,
+            },
+        )
+
     # ------------------------------------------- serving-path scenarios
     # The traffic-facing points (ISSUE 5): `serving.decode` fires per
     # dispatched decode batch inside ModelServer._execute_group,

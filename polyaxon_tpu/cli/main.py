@@ -208,6 +208,13 @@ def stats(run_ref, n_spans, n_events):
                     if rec.get("block")
                     else ""
                 )
+                + (
+                    # an elastic grant below the full ask: the expansion
+                    # pass grows it back when the full block frees up
+                    f" [elastic: {rec['requested_chips']} requested]"
+                    if rec.get("requested_chips")
+                    else ""
+                )
             )
         elif status.get("status") in (V1Statuses.QUEUED, V1Statuses.SCHEDULED):
             click.echo("reservation: none yet (waiting for admission)")

@@ -7,37 +7,68 @@ user-code. Here it is built in: the trainer saves TrainState every
 (or a restarted run) picks up the latest step. Saves are async — device
 arrays are snapshotted, then written in the background without stalling
 the step loop; `wait=True` barriers at the end of the run.
+
+Two tiers (`CheckpointTiers`): when a run configures a LOCAL tier
+(`train.checkpointLocalDir`, e.g. host SSD), every boundary save lands
+there first and a background uploader replicates finished steps to the
+DURABLE tier (the run's outputs dir). Restore searches the union of both
+tiers newest-first, preferring the durable copy of a step and falling
+back to the local one, with the corrupt-quarantine logic applied per
+tier — so a kill mid-upload (chaos point `checkpoint.upload`) costs at
+most the steps since the last boundary, never the run.
 """
 
 from __future__ import annotations
 
 import os
+import queue
+import shutil
 import threading
 from typing import Optional
 
 import jax
 
 _manager_lock = threading.Lock()
-_managers: dict[str, object] = {}
+# directory -> (manager, effective max_to_keep it was built with)
+_managers: dict[str, tuple[object, int]] = {}
 
 
 def _manager(directory: str, keep: Optional[int] = None):
-    """One manager per directory; retention (`keep`) is fixed at first use
-    for that directory — a run has a single policy for its lifetime."""
+    """One manager per directory. When a caller passes a `keep` that
+    disagrees with the cached manager's retention, the manager is flushed
+    and rebuilt so `max_to_keep` always tracks the spec — the first caller
+    no longer pins the policy for the directory's lifetime."""
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
     with _manager_lock:
-        mgr = _managers.get(directory)
-        if mgr is None:
-            mgr = ocp.CheckpointManager(
-                directory,
-                options=ocp.CheckpointManagerOptions(
-                    max_to_keep=keep or 3, enable_async_checkpointing=True
-                ),
-            )
-            _managers[directory] = mgr
+        cached = _managers.get(directory)
+        if cached is not None:
+            mgr, pinned = cached
+            if keep is None or keep == pinned:
+                return mgr
+            try:
+                mgr.wait_until_finished()
+            except Exception:  # noqa: BLE001 — a failed flush cannot block rebuild
+                pass
+            try:
+                mgr.close()
+            except Exception:  # noqa: BLE001
+                pass
+        effective = keep or 3
+        mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=effective, enable_async_checkpointing=True
+            ),
+        )
+        _managers[directory] = (mgr, effective)
         return mgr
+
+
+def _cached_manager(directory: str):
+    cached = _managers.get(os.path.abspath(directory))
+    return cached[0] if cached else None
 
 
 def save_checkpoint(
@@ -56,10 +87,8 @@ def save_checkpoint(
 
 
 def latest_step(directory: str, keep: Optional[int] = None) -> Optional[int]:
-    """`keep` must match the run's retention policy: resume paths touch the
-    manager FIRST, and the per-directory cache pins whatever options the
-    first call used — a keep-less restore would lock the default in and
-    silently override the spec's checkpointKeep for every later save."""
+    """Newest available checkpoint step, or None when the directory is
+    empty or absent."""
     if not directory or not os.path.isdir(directory):
         return None
     return _manager(directory, keep=keep).latest_step()
@@ -74,7 +103,9 @@ def all_steps(directory: str, keep: Optional[int] = None) -> list[int]:
 
 def restore_checkpoint(directory: str, step: int, target, keep: Optional[int] = None):
     """Restore into the sharding/structure of `target` (the freshly built
-    state) so arrays land directly on their mesh devices."""
+    state) so arrays land directly on their mesh devices. Because the
+    target carries the shardings, restoring into a DIFFERENT mesh shape
+    than the one that saved (elastic shrink/grow) is just a restore."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, keep=keep)
@@ -101,7 +132,7 @@ def restore_latest_intact(
     Returns (state, step, corrupt_steps): `(target, 0, [...])` when no
     intact checkpoint exists (train from scratch)."""
     corrupt: list[int] = []
-    mgr = _managers.get(os.path.abspath(directory))
+    mgr = _cached_manager(directory)
     if mgr is not None:
         try:
             # same-process restart: an async save may still be in flight —
@@ -119,17 +150,34 @@ def restore_latest_intact(
     return target, 0, corrupt
 
 
+def _fsync_dir(path: str) -> None:
+    try:
+        dir_fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:
+        # some filesystems (and platforms) refuse directory fsync; callers
+        # treat durability of the rename as best-effort there
+        pass
+
+
 def _quarantine(directory: str, step: int, keep: Optional[int] = None) -> None:
     """Rename a poisoned step dir out of the manager's sight. The manager's
-    in-memory step cache is refreshed by `reload()` where available."""
-    src = os.path.join(os.path.abspath(directory), str(step))
+    in-memory step cache is refreshed by `reload()` where available. The
+    rename is fsynced through the parent directory — a crash right after
+    quarantine must not resurrect the poisoned step under its old name."""
+    parent = os.path.abspath(directory)
+    src = os.path.join(parent, str(step))
     dst = src + ".corrupt"
     try:
         if os.path.isdir(src) and not os.path.exists(dst):
             os.rename(src, dst)
+            _fsync_dir(parent)
     except OSError:
         pass  # already renamed by a peer process, or FS refuses — best effort
-    mgr = _managers.get(os.path.abspath(directory))
+    mgr = _cached_manager(directory)
     reload_fn = getattr(mgr, "reload", None)
     if reload_fn is not None:
         try:
@@ -140,9 +188,248 @@ def _quarantine(directory: str, step: int, keep: Optional[int] = None) -> None:
 
 def close_all():
     with _manager_lock:
-        for mgr in _managers.values():
+        for mgr, _keep in _managers.values():
             try:
                 mgr.close()
             except Exception:
                 pass
         _managers.clear()
+
+
+# --------------------------------------------------------------- tiers
+
+_UPLOAD_SUFFIX = ".uploading"
+
+
+def _tier_counter(name: str, help: str):
+    from ..telemetry import get_registry
+
+    return get_registry().counter(name, help=help)
+
+
+class CheckpointTiers:
+    """Two-tier checkpoint layout for one run.
+
+    `durable` is the run's outputs dir (survives the machine); `local` is
+    an optional fast tier (host SSD / ramdisk) that absorbs every boundary
+    save. With a local tier, `save()` writes there and a background
+    uploader replicates each finished step to the durable tier — copy to a
+    `<step>.uploading` staging dir, fsync, then atomic rename, so the
+    durable tier only ever lists complete steps. Without a local tier the
+    class degrades to the plain single-directory behavior.
+
+    Upload faults are split by severity: an ordinary exception is a
+    durable-tier outage — counted (`checkpoint.upload_failures`), the step
+    stays local-only, training continues. A `SimulatedKill` (abrupt
+    process death at the `checkpoint.upload` chaos point) is stashed and
+    re-raised at the next `save()`/`wait()` so the executor's restart
+    machinery sees it — recovery then comes from the local tier.
+    """
+
+    def __init__(
+        self,
+        durable: str,
+        local: Optional[str] = None,
+        keep: Optional[int] = None,
+    ):
+        self.durable = os.path.abspath(durable)
+        self.local = os.path.abspath(local) if local else None
+        self.keep = keep
+        self._queue: queue.Queue = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._error_lock = threading.Lock()
+        self._upload_error: Optional[BaseException] = None
+
+    # ------------------------------------------------------------ save
+    @property
+    def primary(self) -> str:
+        """The tier boundary saves land on first."""
+        return self.local or self.durable
+
+    def save(self, step: int, state, *, wait: bool = False) -> None:
+        self._raise_pending()
+        save_checkpoint(self.primary, step, state, keep=self.keep)
+        _tier_counter(
+            "checkpoint.tier_writes",
+            "Checkpoint step landings, all tiers (local save + durable upload)",
+        ).inc()
+        if self.local:
+            self._ensure_worker()
+            self._queue.put(step)
+        if wait:
+            self.wait()
+
+    def wait(self) -> None:
+        """Barrier: local saves flushed AND every queued upload settled."""
+        mgr = _cached_manager(self.primary)
+        if mgr is not None:
+            mgr.wait_until_finished()
+        if self.local:
+            self._queue.join()
+        self._raise_pending()
+
+    def _raise_pending(self) -> None:
+        with self._error_lock:
+            err, self._upload_error = self._upload_error, None
+        if err is not None:
+            raise err
+
+    # ---------------------------------------------------------- upload
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._upload_loop, name="ckpt-upload", daemon=True
+            )
+            self._worker.start()
+
+    def _upload_loop(self) -> None:
+        from ..chaos.injector import SimulatedKill
+
+        while True:
+            step = self._queue.get()
+            try:
+                self._replicate(step)
+            except SimulatedKill as e:
+                # abrupt death mid-upload: surface to the step loop so the
+                # executor restarts; the finished local copy carries resume
+                with self._error_lock:
+                    self._upload_error = e
+            except Exception:  # noqa: BLE001 — durable tier outage
+                _tier_counter(
+                    "checkpoint.upload_failures",
+                    "Durable-tier replication failures (step stays local-only)",
+                ).inc()
+            finally:
+                self._queue.task_done()
+
+    def _replicate(self, step: int) -> None:
+        from ..chaos.injector import inject
+
+        src = os.path.join(self.local, str(step))
+        dst = os.path.join(self.durable, str(step))
+        if os.path.isdir(dst):
+            return
+        # the local async save for `step` may still be in flight
+        mgr = _cached_manager(self.local)
+        if mgr is not None:
+            mgr.wait_until_finished()
+        if not os.path.isdir(src):
+            return  # quarantined or pruned before the upload ran
+        os.makedirs(self.durable, exist_ok=True)
+        tmp = os.path.join(self.durable, f"{step}{_UPLOAD_SUFFIX}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            shutil.copytree(src, tmp)
+            _fsync_tree(tmp)
+            # chaos point: a kill here leaves only the staging dir — the
+            # durable tier never lists a half-uploaded step
+            inject(
+                "checkpoint.upload",
+                step=step,
+                src=src,
+                directory=self.durable,
+            )
+            os.rename(tmp, dst)
+            _fsync_dir(self.durable)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        _tier_counter(
+            "checkpoint.tier_writes",
+            "Checkpoint step landings, all tiers (local save + durable upload)",
+        ).inc()
+        mgr = _cached_manager(self.durable)
+        reload_fn = getattr(mgr, "reload", None)
+        if reload_fn is not None:
+            try:
+                reload_fn()
+            except Exception:  # noqa: BLE001
+                pass
+        self._prune_durable()
+
+    def _prune_durable(self) -> None:
+        """Mirror the local manager's retention on the durable tier: the
+        uploader bypasses the manager, so old steps are trimmed by hand."""
+        keep = self.keep or 3
+        try:
+            steps = sorted(
+                int(name)
+                for name in os.listdir(self.durable)
+                if name.isdigit()
+            )
+        except OSError:
+            return
+        for step in steps[:-keep] if keep else []:
+            shutil.rmtree(
+                os.path.join(self.durable, str(step)), ignore_errors=True
+            )
+
+    # --------------------------------------------------------- restore
+    def steps_by_tier(self) -> dict[str, list[int]]:
+        out = {"durable": all_steps(self.durable, keep=self.keep)}
+        if self.local:
+            out["local"] = all_steps(self.local, keep=self.keep)
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        by_tier = self.steps_by_tier()
+        union = sorted(set().union(*by_tier.values()))
+        return union[-1] if union else None
+
+    def restore_latest_intact(self, target):
+        """Newest intact checkpoint across BOTH tiers.
+
+        Steps are tried newest-first over the union of tiers; within a
+        step the durable copy is preferred and the local copy is the
+        fallback. A copy whose restore raises is quarantined in ITS tier
+        only — a scrambled durable upload falls back to the local copy of
+        the same step before giving up the step entirely.
+
+        Returns (state, step, corrupt, tier): corrupt is a list of
+        (tier, step) pairs; tier is "durable"/"local"/None (scratch)."""
+        corrupt: list[tuple[str, int]] = []
+        for directory in filter(None, (self.local, self.durable)):
+            mgr = _cached_manager(directory)
+            if mgr is not None:
+                try:
+                    # same-process restart: async save may still be writing
+                    mgr.wait_until_finished()
+                except Exception:  # noqa: BLE001
+                    pass
+        if self.local:
+            try:
+                self._queue.join()  # in-flight uploads are good copies
+            except Exception:  # noqa: BLE001
+                pass
+        by_tier = self.steps_by_tier()
+        tier_dirs = {"durable": self.durable, "local": self.local}
+        for step in sorted(set().union(*by_tier.values()), reverse=True):
+            for tier in ("durable", "local"):
+                if step not in by_tier.get(tier, ()):
+                    continue
+                try:
+                    state = restore_checkpoint(
+                        tier_dirs[tier], step, target, keep=self.keep
+                    )
+                    return state, step, corrupt, tier
+                except Exception:  # noqa: BLE001 — fall through per tier
+                    corrupt.append((tier, step))
+                    _quarantine(tier_dirs[tier], step, keep=self.keep)
+        return target, 0, corrupt, None
+
+
+def _fsync_tree(root: str) -> None:
+    """fsync every file then every directory under `root`, bottom-up, so
+    the staging copy is on disk before the publishing rename."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            try:
+                fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass
+        _fsync_dir(dirpath)

@@ -18,6 +18,7 @@ import subprocess
 import sys
 import time
 import traceback
+from pathlib import Path
 from typing import Optional
 
 from ..compiler.resolver import CompiledOperation
@@ -206,6 +207,9 @@ class Executor:
                 "step": getattr(exc, "step", None),
                 "restart": restarts + 1,
                 "scheduler": True,
+                # the gang size this attempt actually ran at — the next
+                # admission pass may grant a different rung of the ladder
+                "granted_chips": meta.get("granted_chips"),
             },
         )
         store.set_status(
@@ -215,19 +219,88 @@ class Executor:
             message=str(exc),
         )
         store.set_status(run_uuid, V1Statuses.QUEUED)
-        from ..scheduler.fleet import Fleet
+        from ..scheduler.fleet import (
+            Fleet,
+            chips_demand,
+            min_chips_demand,
+            topology_request,
+        )
         from ..scheduler.queue import RunQueue
 
         Fleet(store).release(run_uuid)  # chips go to the preemptor
+        # re-stamp the FULL demand (not the shrunk grant): the next pass
+        # tries the whole block first and walks the ladder down again
+        op = compiled.operation
+        block = topology_request(op)
         RunQueue(store, name=meta.get("queue") or "default").push(
             run_uuid,
             {
-                "operation": compiled.operation.to_dict(),
+                "operation": op.to_dict(),
                 "project": compiled.project,
             },
             priority=int(meta.get("priority", 0)),
+            chips=chips_demand(op),
+            min_chips=min_chips_demand(op),
+            block=list(block) if block else None,
         )
         return V1Statuses.QUEUED
+
+    def _apply_elastic_grant(self, compiled: CompiledOperation, program):
+        """Resize the attempt to the gang the scheduler actually granted.
+
+        Admission stamps `granted_chips` on the run meta when it places an
+        elastic run on a rung below its full request. The trainer then
+        builds its mesh over that many devices (restore reshards for free)
+        and gradient accumulation scales by the shrink ratio so the global
+        batch — and per-device microbatch footprint — hold constant.
+
+        Returns (program, devices): untouched when the grant matches the
+        request (or the run is not elastic)."""
+        from ..scheduler.fleet import chips_demand, min_chips_demand
+
+        store, run_uuid = self.store, compiled.run_uuid
+        meta = store.get_status(run_uuid).get("meta") or {}
+        granted = meta.get("granted_chips")
+        if granted is None or min_chips_demand(compiled.operation) is None:
+            return program, self.devices
+        granted = int(granted)
+        requested = chips_demand(compiled.operation)
+        devices = self.devices
+        if devices is None:
+            import jax
+
+            devices = list(jax.devices())
+        if granted >= min(requested, len(devices)):
+            return program, self.devices
+        ratio = max(1, requested // granted)
+        devices = list(devices)[:granted]
+        tspec = program.train
+        accum = int(tspec.grad_accum) if tspec and tspec.grad_accum else 1
+        new_accum = accum * ratio
+        if tspec is not None:
+            program = program.model_copy(
+                update={
+                    "train": tspec.model_copy(
+                        update={"grad_accum": new_accum}
+                    )
+                }
+            )
+        from ..telemetry import get_registry
+
+        get_registry().counter(
+            "trainer.elastic_resizes",
+            help="Training attempts started at a resized gang",
+        ).inc()
+        store.log_event(
+            run_uuid,
+            "elastic_resize",
+            {
+                "granted": granted,
+                "requested": requested,
+                "grad_accum": new_accum,
+            },
+        )
+        return program, devices
 
     def _stopped(self, run_uuid: str) -> bool:
         """True when a stop request landed; settles STOPPING → STOPPED."""
@@ -630,9 +703,16 @@ class Executor:
         n_slices = run_num_slices(run)
 
         ckpt_dir = None
+        local_ckpt_dir = None
         tspec = run.program.train
         if tspec and (tspec.checkpoint_every or tspec.resume):
             ckpt_dir = str(store.outputs_dir(run_uuid) / "checkpoints")
+            if tspec.checkpoint_local_dir:
+                # fast tier, scoped per run so two runs on one host never
+                # share a step namespace
+                local_ckpt_dir = str(
+                    Path(tspec.checkpoint_local_dir) / run_uuid / "checkpoints"
+                )
         program = run.program
         if resume and ckpt_dir is None:
             # retry without explicit checkpointing: restart from scratch
@@ -641,6 +721,7 @@ class Executor:
             program = program.model_copy(
                 update={"train": tspec.model_copy(update={"resume": True})}
             )
+        program, devices = self._apply_elastic_grant(compiled, program)
 
         replicas = int(getattr(run, "replicas", 1) or 1)
         if replicas > 1:
@@ -668,11 +749,12 @@ class Executor:
         trainer = Trainer(
             program,
             mesh_axes=mesh_axes,
-            devices=self.devices,
+            devices=devices,
             slices=n_slices,
             log_fn=log_fn,
             event_fn=lambda kind, body: store.log_event(run_uuid, kind, body),
             checkpoint_dir=ckpt_dir,
+            local_checkpoint_dir=local_ckpt_dir,
             artifacts_dir=str(store.outputs_dir(run_uuid)),
         )
         store.set_status(run_uuid, V1Statuses.RUNNING)

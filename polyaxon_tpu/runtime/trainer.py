@@ -123,6 +123,7 @@ class Trainer:
         log_fn: Optional[Callable[[int, dict], None]] = None,
         event_fn: Optional[Callable[[str, dict], None]] = None,
         checkpoint_dir: Optional[str] = None,
+        local_checkpoint_dir: Optional[str] = None,
         artifacts_dir: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
@@ -137,6 +138,10 @@ class Trainer:
         self.tspec = tspec
         self.log_fn = log_fn or (lambda step, m: None)
         self.checkpoint_dir = checkpoint_dir
+        self.local_checkpoint_dir = local_checkpoint_dir or (
+            tspec.checkpoint_local_dir if tspec else None
+        )
+        self._tiers = None
         # ONE metrics pipeline: every number the trainer reports flows
         # through this registry (and from there to the store via _emit).
         obs = program.observability
@@ -367,11 +372,31 @@ class Trainer:
         grad_accum = int(tspec.grad_accum) if tspec.grad_accum else 1
         if grad_accum < 1:
             raise ValueError(f"train.gradAccum must be >= 1, got {grad_accum}")
-        if global_batch % (grad_accum * local_batch_slice(mesh)) != 0:
-            raise ValueError(
-                f"global batch {global_batch} not divisible by gradAccum "
-                f"{grad_accum} x batch-sharded mesh axes {local_batch_slice(mesh)}"
+        # the divisibility contract is an automatic adjustment, not an
+        # error: an elastic resize changes the batch-sharded mesh width, so
+        # pick the smallest feasible accumulation >= the requested one that
+        # keeps the global batch constant (microbatch = global/(g*shards))
+        microbatches = global_batch // local_batch_slice(mesh)
+        if microbatches % grad_accum != 0:
+            requested = grad_accum
+            grad_accum = next(
+                (
+                    g
+                    for g in range(requested, microbatches + 1)
+                    if microbatches % g == 0
+                ),
+                microbatches,
             )
+            self._event(
+                "grad_accum_adjusted",
+                {
+                    "requested": requested,
+                    "effective": grad_accum,
+                    "global_batch": global_batch,
+                    "batch_shards": local_batch_slice(mesh),
+                },
+            )
+        self.grad_accum = grad_accum
 
         def grads_of(params, extra, batch, rng):
             """One microbatch: (loss, grads, new_extra, logits)."""
@@ -586,6 +611,16 @@ class Trainer:
         steps_ctr = self.telemetry.counter(
             "trainer.steps", help="Training steps completed"
         )
+        # process-global on purpose: the canary reads this off /metricsz to
+        # pin that async checkpointing keeps the step-loop stall near zero
+        from ..telemetry import get_registry
+
+        stall_hist = get_registry().histogram(
+            "trainer.checkpoint_stall_ms",
+            buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+                     1000.0, 5000.0),
+            help="Step-loop stall per boundary save (async write), ms",
+        )
         t0 = _now()
         self._win = {"t0": t0, "steps": 0, "wait": 0.0, "busy": 0.0}
         for step in range(start_step, self.steps):
@@ -628,8 +663,15 @@ class Trainer:
                             self._emit(history, *pending)
                             pending = None
                         self._emit(history, step + 1, eval_metrics)
-                    if ckpt_every and (step + 1) % ckpt_every == 0:
+                if ckpt_every and (step + 1) % ckpt_every == 0:
+                    # the save is async (Orbax snapshots on device, writes in
+                    # the background) — this span measures the REAL stall the
+                    # step loop pays, which should stay near-zero
+                    with self.tracer.span(
+                        "checkpoint", step=step + 1
+                    ) as ckpt_span:
                         self.save(step + 1)
+                    stall_hist.observe(ckpt_span.dur_s * 1000.0)
             step_hist.observe(step_span.dur_s)
             wait_hist.observe(wait_span.dur_s)
             busy_hist.observe(busy_span.dur_s)
@@ -794,9 +836,7 @@ class Trainer:
         observes the flag, so the saved step IS the resume point."""
         saved = None
         if self.checkpoint_dir:
-            from .checkpoint import latest_step
-
-            saved = latest_step(self.checkpoint_dir, keep=self._ckpt_keep())
+            saved = self._checkpoint_tiers().latest_step()
             if step > start_step and (saved or 0) < step:
                 self.save(step, wait=True)
                 saved = step
@@ -824,31 +864,38 @@ class Trainer:
             else None
         )
 
-    def save(self, step: int, wait: bool = False):
-        from .checkpoint import save_checkpoint
+    def _checkpoint_tiers(self):
+        if self._tiers is None and self.checkpoint_dir:
+            from .checkpoint import CheckpointTiers
 
-        save_checkpoint(
-            self.checkpoint_dir, step, self.state, wait=wait,
-            keep=self._ckpt_keep(),
-        )
+            self._tiers = CheckpointTiers(
+                self.checkpoint_dir,
+                local=self.local_checkpoint_dir,
+                keep=self._ckpt_keep(),
+            )
+        return self._tiers
+
+    def save(self, step: int, wait: bool = False):
+        self._checkpoint_tiers().save(step, self.state, wait=wait)
 
     def restore(self) -> int:
-        # keep flows through restore too: the per-directory manager cache
-        # pins its options at FIRST touch, and resume touches it before the
-        # first save — a keep-less call here would lock in the default
-        from .checkpoint import restore_latest_intact
-
-        state, step, corrupt = restore_latest_intact(
-            self.checkpoint_dir, self.state, keep=self._ckpt_keep()
-        )
+        # the newest intact step across BOTH tiers: durable copy preferred,
+        # local copy as fallback (a kill mid-upload leaves the newest step
+        # local-only), corrupt copies quarantined per tier
+        state, step, corrupt, tier = self._checkpoint_tiers(
+        ).restore_latest_intact(self.state)
         if corrupt:
             self._event(
                 "checkpoint_fallback",
-                {"corrupt_steps": corrupt, "restored_step": step},
+                {
+                    "corrupt_steps": sorted({s for _t, s in corrupt}),
+                    "corrupt_copies": [[t, s] for t, s in corrupt],
+                    "restored_step": step,
+                },
             )
         if step > 0:
             self.state = state
-            self._event("resumed", {"step": step})
+            self._event("resumed", {"step": step, "tier": tier})
         return step
 
 

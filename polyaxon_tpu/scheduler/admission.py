@@ -44,7 +44,13 @@ from typing import Optional
 
 from ..schemas.quota import V1QuotaSpec
 from ..store.local import RunStore
-from .fleet import Fleet, chips_demand, topology_request
+from .fleet import (
+    Fleet,
+    chips_demand,
+    min_chips_demand,
+    shrink_candidates,
+    topology_request,
+)
 
 # queue-wait-shaped buckets, in milliseconds: 1ms .. 10min
 QUEUE_WAIT_BUCKETS_MS: tuple[float, ...] = (
@@ -198,6 +204,17 @@ class AdmissionController:
         op = (entry.get("payload") or {}).get("operation") or {}
         return chips_demand(op), topology_request(op)
 
+    @staticmethod
+    def min_demand(entry: dict) -> Optional[int]:
+        """The elastic floor, or None for a rigid run. Stamped at submit
+        time like `chips`; re-derived from the payload for legacy
+        entries."""
+        floor = entry.get("min_chips")
+        if floor is not None:
+            return int(floor)
+        op = (entry.get("payload") or {}).get("operation") or {}
+        return min_chips_demand(op)
+
     # ------------------------------------------------------------- order
     def order(self, entries: list[dict]) -> list[dict]:
         """Claim order: priority first; at equal priority, fair-share
@@ -233,9 +250,12 @@ class AdmissionController:
     def try_admit(self, entry: dict, queue_name: str = "default") -> Decision:
         """Full admission pass for one queue entry: quota check, gang
         reservation, then preemption-victim selection when a higher
-        priority cannot fit. Telemetry counters land on the global
-        registry here so every surface (agent, simulator) reports the
-        same series."""
+        priority cannot fit. Elastic runs (`minChips` set) walk the
+        halving ladder: the full block first, then successively smaller
+        sub-blocks down to the floor, so a shrinkable run never parks in
+        WAIT while an admissible smaller grant exists. Telemetry counters
+        land on the global registry here so every surface (agent,
+        simulator) reports the same series."""
         from ..telemetry import get_registry
 
         reg = get_registry()
@@ -244,16 +264,26 @@ class AdmissionController:
         project = payload.get("project") or "default"
         priority = int(entry.get("priority", 0))
         chips, block = self.demand(entry)
+        min_chips = self.min_demand(entry)
         inv = self.fleet.inventory()
         if inv is None:
             return Decision(ADMIT, reason="no fleet configured")
 
-        if not inv.fits(chips, block=block):
+        sizes: list[tuple[int, Optional[tuple[int, ...]]]] = [(chips, block)]
+        if min_chips is not None and min_chips < chips:
+            sizes += shrink_candidates(chips, block, min_chips)
+
+        floor_chips, floor_block = sizes[-1]
+        if not inv.fits(floor_chips, block=floor_block):
             reg.counter(
                 "admission.rejected",
                 help="Runs marked unschedulable at admission",
             ).inc()
-            shape = "x".join(map(str, block)) if block else str(chips)
+            shape = (
+                "x".join(map(str, floor_block))
+                if floor_block
+                else str(floor_chips)
+            )
             return Decision(
                 REJECT,
                 reason=(
@@ -267,43 +297,122 @@ class AdmissionController:
                 ),
             )
 
-        outcome, reason = self.quotas.check(
-            project, queue_name, chips, self._scope_usage()
-        )
-        if outcome == REJECT:
-            reg.counter(
-                "admission.rejected",
-                help="Runs marked unschedulable at admission",
-            ).inc()
-            return Decision(REJECT, reason=reason)
-        if outcome == WAIT:
+        usage = self._scope_usage()
+        quota_wait: Optional[str] = None
+        quota_reject: Optional[str] = None
+        tried_reserve = False
+        for cand_chips, cand_block in sizes:
+            if not inv.fits(cand_chips, block=cand_block):
+                continue
+            outcome, reason = self.quotas.check(
+                project, queue_name, cand_chips, usage
+            )
+            if outcome == REJECT:
+                quota_reject = quota_reject or reason
+                continue
+            if outcome == WAIT:
+                quota_wait = quota_wait or reason
+                continue
+            tried_reserve = True
+            record = self.fleet.reserve(
+                uuid,
+                chips=cand_chips,
+                block=cand_block,
+                project=project,
+                queue=queue_name,
+                priority=priority,
+                requested_chips=chips,
+                requested_block=block,
+            )
+            if record is None:
+                continue
+            if min_chips is not None:
+                self._record_grant(uuid, granted=cand_chips, requested=chips)
+            return Decision(ADMIT, reservation=record)
+
+        if tried_reserve:
+            victims = self.pick_victims(floor_chips, floor_block, priority)
+            if victims:
+                for v in victims:
+                    self.request_preemption(v["uuid"], by=uuid)
+                return Decision(
+                    WAIT,
+                    reason=f"preempting {len(victims)} lower-priority run(s)",
+                    preempt=[v["uuid"] for v in victims],
+                )
+            return Decision(WAIT, reason="insufficient free chips")
+        if quota_wait is not None:
             reg.counter(
                 "admission.throttled",
                 help="Claims deferred by quota limits",
             ).inc()
-            return Decision(WAIT, reason=reason)
-
-        record = self.fleet.reserve(
-            uuid,
-            chips=chips,
-            block=block,
-            project=project,
-            queue=queue_name,
-            priority=priority,
-        )
-        if record is not None:
-            return Decision(ADMIT, reservation=record)
-
-        victims = self.pick_victims(chips, block, priority)
-        if victims:
-            for v in victims:
-                self.request_preemption(v["uuid"], by=uuid)
-            return Decision(
-                WAIT,
-                reason=f"preempting {len(victims)} lower-priority run(s)",
-                preempt=[v["uuid"] for v in victims],
-            )
+            return Decision(WAIT, reason=quota_wait)
+        if quota_reject is not None:
+            reg.counter(
+                "admission.rejected",
+                help="Runs marked unschedulable at admission",
+            ).inc()
+            return Decision(REJECT, reason=quota_reject)
         return Decision(WAIT, reason="insufficient free chips")
+
+    def _record_grant(self, uuid: str, granted: int, requested: int) -> None:
+        """Stamp the granted gang size where the executor reads it; count
+        shrunk grants. Store writes are skipped for entries with no run in
+        the store (the simulator replays admission without one)."""
+        from ..telemetry import get_registry
+
+        if self.store.get_status(uuid):
+            self.store.set_meta(
+                uuid, granted_chips=granted, requested_chips=requested
+            )
+            if granted < requested:
+                self.store.log_event(
+                    uuid,
+                    "elastic_shrink",
+                    {"granted": granted, "requested": requested},
+                )
+        if granted < requested:
+            get_registry().counter(
+                "scheduler.elastic_shrinks",
+                help="Elastic grants below the requested gang size",
+            ).inc()
+
+    def consider_expansion(self) -> list[str]:
+        """Find shrunk elastic reservations whose FULL request could place
+        once their own chips are freed, and flag each for the same
+        checkpoint-and-requeue path preemption uses — the run re-enters
+        the queue and re-admits at full size on a following pass."""
+        inv = self.fleet.inventory()
+        if inv is None:
+            return []
+        all_res = self.fleet.ledger.all()
+        expanded = []
+        for uuid, rec in all_res.items():
+            requested = int(rec.get("requested_chips") or rec["chips"])
+            if requested <= int(rec["chips"]):
+                continue
+            req_block = (
+                tuple(rec["requested_block"])
+                if rec.get("requested_block")
+                else None
+            )
+            used = {
+                tuple(c)
+                for u, other in all_res.items()
+                if u != uuid
+                for c in other["coords"]
+            }
+            if inv.place(requested, used, block=req_block) is None:
+                continue
+            self.request_preemption(uuid, by="elastic-expansion")
+            if self.store.get_status(uuid):
+                self.store.log_event(
+                    uuid,
+                    "elastic_expand_requested",
+                    {"from": int(rec["chips"]), "to": requested},
+                )
+            expanded.append(uuid)
+        return expanded
 
     # -------------------------------------------------------- preemption
     def pick_victims(

@@ -110,7 +110,7 @@ class Agent:
         self.store.set_status(compiled.run_uuid, V1Statuses.QUEUED)
         # chip demand is stamped on the queue entry at submit time so the
         # admission controller never has to re-compile specs while scanning
-        from .fleet import chips_demand, topology_request
+        from .fleet import chips_demand, min_chips_demand, topology_request
 
         block = topology_request(compiled.operation)
         routed_queue.push(
@@ -118,6 +118,7 @@ class Agent:
             {"operation": compiled.operation.to_dict(), "project": compiled.project},
             priority=priority,
             chips=chips_demand(compiled.operation),
+            min_chips=min_chips_demand(compiled.operation),
             block=list(block) if block else None,
         )
         return compiled.run_uuid
@@ -272,6 +273,13 @@ class Agent:
         count = 0
         while max_runs is None or count < max_runs:
             progressed = False
+            if self.admission.active:
+                # shrunk elastic runs grow back through the normal
+                # checkpoint-and-requeue path when their full block frees up
+                try:
+                    self.admission.consider_expansion()
+                except Exception:  # noqa: BLE001 — expansion is best-effort
+                    pass
             for q, settings in self._queues():
                 conc = int(settings.get("concurrency", 1))
                 if conc <= 0:

@@ -67,6 +67,48 @@ def chips_demand(spec: Any) -> int:
     return 1
 
 
+def min_chips_demand(spec: Any) -> Optional[int]:
+    """The elastic floor (`resources.minChips`), or None when the run is
+    rigid. Capped at the full demand — a floor above the request is a spec
+    error the schema already rejects, but stored dicts are unchecked."""
+    for env in _environments(spec):
+        resources = _get(env, "resources")
+        if resources is None:
+            continue
+        floor = _get(resources, "min_chips")
+        if floor is None and isinstance(resources, dict):
+            floor = resources.get("minChips")
+        if floor:
+            return min(int(floor), chips_demand(spec))
+    return None
+
+
+def shrink_candidates(
+    chips: int,
+    block: Optional[tuple[int, ...]],
+    min_chips: int,
+) -> list[tuple[int, Optional[tuple[int, ...]]]]:
+    """The halving ladder strictly below the full request, floored at
+    `min_chips`: each rung halves the block's largest axis (topology
+    requests) or the chip count (flat requests), so gradient-accumulation
+    rescaling stays integral and sub-blocks keep tiling the torus."""
+    out: list[tuple[int, Optional[tuple[int, ...]]]] = []
+    if block is not None:
+        cur = list(block)
+        while math.prod(cur) // 2 >= min_chips:
+            axis = max(range(len(cur)), key=lambda i: cur[i])
+            if cur[axis] % 2:
+                break
+            cur[axis] //= 2
+            out.append((math.prod(cur), tuple(cur)))
+    else:
+        c = chips // 2
+        while c >= min_chips:
+            out.append((c, None))
+            c //= 2
+    return out
+
+
 def topology_request(spec: Any) -> Optional[tuple[int, ...]]:
     """The requested ICI block shape, when the run pins one (`tpu:
     {topology: ...}`); None for count/chips requests."""
@@ -315,9 +357,14 @@ class Fleet:
         project: str = "default",
         queue: str = "default",
         priority: int = 0,
+        requested_chips: Optional[int] = None,
+        requested_block: Optional[tuple[int, ...]] = None,
     ) -> Optional[dict]:
         """All-or-nothing gang reservation: the whole slice or None.
-        Idempotent per run (re-reserving returns the existing record)."""
+        Idempotent per run (re-reserving returns the existing record).
+        `requested_chips`/`requested_block` record the FULL elastic demand
+        when `chips` is a shrunk grant, so the expansion pass can see which
+        reservations are running below their ask."""
         inv = self.inventory()
         if inv is None:
             return None
@@ -339,6 +386,11 @@ class Fleet:
                 "priority": int(priority),
                 "reserved_at": self.clock.time(),
             }
+            if requested_chips is not None and requested_chips != chips:
+                record["requested_chips"] = int(requested_chips)
+                record["requested_block"] = (
+                    list(requested_block) if requested_block else None
+                )
             data[run_uuid] = record
             return record, data
 

@@ -33,10 +33,11 @@ from .queue import RunQueue
 @dataclass
 class SimJob:
     name: str
-    duration: float  # seconds of work on the chips
+    duration: float  # seconds of work at the FULL chip request
     arrival: float = 0.0
     chips: int = 1
     block: Optional[tuple[int, ...]] = None
+    min_chips: Optional[int] = None  # elastic floor; None = rigid gang
     project: str = "default"
     queue: str = "default"
     priority: int = 0
@@ -50,9 +51,18 @@ class SimJob:
     preemptions: int = 0
     waits: list = field(default_factory=list)  # one wait per admission
     final_status: str = ""
+    granted: Optional[int] = None  # chips of the current/last grant
+    grants: list = field(default_factory=list)  # grant size per admission
+    resizes: int = 0  # admissions at a size != the full request
 
     def __post_init__(self):
         self.remaining = float(self.duration)
+
+    @property
+    def rate(self) -> float:
+        """Work per wall-second: a shrunk grant runs proportionally
+        slower (duration/remaining are denominated at full size)."""
+        return (self.granted or self.chips) / self.chips
 
 
 class FleetSimulator:
@@ -113,6 +123,7 @@ class FleetSimulator:
             {"project": job.project},
             priority=job.priority,
             chips=job.chips,
+            min_chips=job.min_chips,
             block=list(job.block) if job.block else None,
             enqueued_at=job.enqueued_at,
         )
@@ -120,6 +131,14 @@ class FleetSimulator:
     def _start(self, job: SimJob) -> None:
         job.waits.append(self.clock.time() - job.enqueued_at)
         job.started_at = self.clock.time()
+        # the grant may be a rung below the full request (elastic shrink):
+        # the reservation record is the source of truth, exactly as the
+        # executor reads granted_chips off the run meta
+        rec = self.fleet.ledger.get(job.uuid)
+        job.granted = int(rec["chips"]) if rec else job.chips
+        job.grants.append(job.granted)
+        if job.granted != job.chips:
+            job.resizes += 1
         for s in (V1Statuses.SCHEDULED, V1Statuses.STARTING, V1Statuses.RUNNING):
             self.store.set_status(job.uuid, s)
         self.running[job.uuid] = job
@@ -138,7 +157,9 @@ class FleetSimulator:
         checkpoint progress at this instant, release chips, requeue at the
         ORIGINAL priority with a fresh seq (back of its priority band)."""
         del self.running[job.uuid]
-        done = self.clock.time() - job.started_at
+        # work done at the granted rate (a shrunk grant earns proportionally
+        # less progress per wall-second)
+        done = (self.clock.time() - job.started_at) * job.rate
         job.progress += done  # the checkpoint: completed work survives
         job.remaining -= done
         job.preemptions += 1
@@ -158,6 +179,7 @@ class FleetSimulator:
             {"project": job.project},
             priority=job.priority,
             chips=job.chips,
+            min_chips=job.min_chips,
             block=list(job.block) if job.block else None,
             enqueued_at=job.enqueued_at,
         )
@@ -167,8 +189,19 @@ class FleetSimulator:
         """Run admission to a fixed point: admissions free no chips, but a
         preemption request evicts victims (cooperatively, instantly in sim
         time) which can unblock the requester on the next iteration."""
+        expanded_this_pass: set = set()
         while True:
             changed = False
+            # grow-back: a shrunk elastic run whose full block now places
+            # goes through checkpoint-and-requeue and re-admits at full
+            # size in this same fixed point. At most once per job per pass
+            # so a backfill stealing the freed chips cannot ping-pong it.
+            for uuid in self.admission.consider_expansion():
+                job = self.running.get(uuid)
+                if job is not None and uuid not in expanded_this_pass:
+                    expanded_this_pass.add(uuid)
+                    self._preempt(job)
+                    changed = True
             # one globally-ordered scan over ALL queues: the preemptor (by
             # definition higher priority) is always offered freed chips
             # before anything that could backfill into them
@@ -219,7 +252,10 @@ class FleetSimulator:
         while pending or self.running:
             next_arrival = pending[0].arrival if pending else None
             next_finish = (
-                min(j.started_at + j.remaining for j in self.running.values())
+                min(
+                    j.started_at + j.remaining / j.rate
+                    for j in self.running.values()
+                )
                 if self.running
                 else None
             )
@@ -233,7 +269,8 @@ class FleetSimulator:
             for job in [
                 j
                 for j in self.running.values()
-                if j.started_at + j.remaining <= self.clock.time() + 1e-9
+                if j.started_at + j.remaining / j.rate
+                <= self.clock.time() + 1e-9
             ]:
                 self._finish(job)
             self._schedule_pass()
@@ -265,6 +302,7 @@ class FleetSimulator:
                 chip_seconds / (total * makespan), 4
             ) if makespan else 0.0,
             "preemptions": sum(j.preemptions for j in self.jobs),
+            "elastic_resizes": sum(j.resizes for j in self.jobs),
             "events": self.events,
         }
 

@@ -138,6 +138,12 @@ class V1Resources(BaseSchema):
     memory: Optional[str | int] = None
     gpu: Optional[int] = None
     chips: Optional[int] = None
+    # elastic floor: `minChips <= chips` declares the run can start (or
+    # resume after preemption) on any power-of-two shrink of its request
+    # down to this many chips, instead of parking in WAIT until the full
+    # block frees up. The trainer reshards state and rescales gradient
+    # accumulation to hold the global batch constant.
+    min_chips: Optional[int] = None
     tpu: Optional[V1TpuSpec] = None
     limits: Optional[dict[str, float | int | str]] = None
     requests: Optional[dict[str, float | int | str]] = None
@@ -148,6 +154,25 @@ class V1Resources(BaseSchema):
         if v is not None and v < 1:
             raise ValueError(f"chips must be >= 1, got {v}")
         return v
+
+    @model_validator(mode="after")
+    def _check_min_chips(self):
+        if self.min_chips is not None:
+            if self.min_chips < 1:
+                raise ValueError(
+                    f"minChips must be >= 1, got {self.min_chips}"
+                )
+            full = (
+                self.tpu.total_chips
+                if self.tpu is not None
+                else self.chips
+            )
+            if full is not None and self.min_chips > full:
+                raise ValueError(
+                    f"minChips {self.min_chips} exceeds the full request "
+                    f"({full} chips) — the elastic range is minChips <= chips"
+                )
+        return self
 
 
 class V1Environment(BaseSchema):

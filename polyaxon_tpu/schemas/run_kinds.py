@@ -89,6 +89,11 @@ class V1TrainSpec(BaseSchema):
     # artifact store. Default 3; must be >= 1 when set (0 would silently
     # coerce to the default, negatives would flow into Orbax unchecked).
     checkpoint_keep: Optional[int | str] = None
+    # fast checkpoint tier (host SSD / ramdisk): boundary saves land here
+    # first and replicate to the durable outputs dir in the background
+    # (runtime/checkpoint.py CheckpointTiers). The executor scopes the
+    # path per run (<dir>/<uuid>); restore searches durable-then-local.
+    checkpoint_local_dir: Optional[str] = None
     resume: Optional[bool] = None
     seed: int | str = 0
     precision: Literal["bfloat16", "float32", "mixed"] = "mixed"

@@ -31,6 +31,16 @@ and occupancy math to the host clock, making paged-vs-dense replay
 nondeterministic and TTFT double-clocked. Any `time.time/monotonic/
 perf_counter` (and `_ns` variants) there is forbidden.
 
+Fifth rule: NO raw clock in checkpoint-tier/elastic accounting. The
+tiered-checkpoint module (`polyaxon_tpu/runtime/checkpoint.py`) orders
+saves/uploads/restores purely by step number, and the one duration that
+matters — the step-loop checkpoint stall — is measured by the trainer's
+span tree on the telemetry clock (`trainer_checkpoint_stall_ms`). A raw
+`time.*()` read inside the tier machinery would grow a second stall
+clock that can disagree with the histogram the canary gates on, so any
+`time.time/monotonic/perf_counter` (and `_ns` variants) there is
+forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -54,6 +64,12 @@ KV_MODULES = (
     ("polyaxon_tpu", "models", "kv_pages.py"),
     ("polyaxon_tpu", "serving", "kv.py"),
 )
+CKPT_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter)(?:_ns)?\s*\("
+)
+CKPT_MODULES = (
+    ("polyaxon_tpu", "runtime", "checkpoint.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -67,6 +83,7 @@ def violations(repo_root: Path) -> list[str]:
         clock_exempt = in_scheduler and rel.name == "clock.py"
         in_serving = rel.parts[:2] == ("polyaxon_tpu", "serving")
         in_kv = rel.parts in KV_MODULES
+        in_ckpt = rel.parts in CKPT_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -88,6 +105,12 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: raw clock in page-pool accounting — "
                     f"use a logical tick or the telemetry clock "
                     f"helpers: {line.strip()}"
+                )
+            if in_ckpt and CKPT_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in checkpoint-tier/elastic "
+                    f"accounting — order by step number; durations go "
+                    f"through the trainer's telemetry spans: {line.strip()}"
                 )
     return out
 

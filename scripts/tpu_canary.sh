@@ -270,6 +270,131 @@ PY
       echo "KV-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # elastic gate: a seeded preempt-shrink-resume through the REAL stack
+    # (two-tier checkpoints, eviction at peak, halving-ladder re-admission
+    # on a half-stolen fleet), then require the elastic series on
+    # /metricsz. A resize path whose telemetry is dark would hide both
+    # checkpoint stalls and silent capacity downgrades, so a missing
+    # series FAILS the run.
+    echo "running elastic metricsz smoke $(date -u +%T)" >> "$log"
+    if ! timeout 900 python - >> "$log" 2>&1 <<'PY'
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+# the shrink must be a REAL mesh reduction: off-TPU (local dry runs) the
+# host would expose a single CPU device and the 2->1 grant would no-op
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+
+sys.path.insert(0, ".")
+from polyaxon_tpu.scheduler.agent import Agent
+from polyaxon_tpu.scheduler.fleet import Fleet
+from polyaxon_tpu.schemas.operation import V1Operation
+from polyaxon_tpu.store import RunStore
+from polyaxon_tpu.streams.server import make_server
+
+home = tempfile.mkdtemp(prefix="canary-elastic-")
+local = tempfile.mkdtemp(prefix="canary-elastic-fast-")
+EVICT_AT, STEPS = 4, 6
+
+
+class EvictAtPeak(RunStore):
+    target = None
+
+    def log_metrics(self, run_uuid, step, metrics):
+        super().log_metrics(run_uuid, step, metrics)
+        if run_uuid == self.target and step == EVICT_AT:
+            meta = (self.get_status(run_uuid) or {}).get("meta") or {}
+            if not meta.get("preempt_restarts"):
+                self.set_meta(run_uuid, preempt_requested=True)
+
+
+store = EvictAtPeak(home)
+Fleet(store).configure(chips=2)
+agent = Agent(store=store)
+op = V1Operation.model_validate({
+    "kind": "operation",
+    "name": "canary-elastic",
+    "environment": {"resources": {"chips": 2, "minChips": 1}},
+    "component": {
+        "kind": "component",
+        "name": "c",
+        "termination": {"maxRetries": 0},
+        "run": {
+            "kind": "jaxjob",
+            "program": {
+                "model": {"name": "mlp", "config": {
+                    "input_dim": 8, "num_classes": 2, "hidden": [4]}},
+                "data": {"name": "synthetic", "batchSize": 8,
+                         "config": {"shape": [8], "num_classes": 2}},
+                "optimizer": {"name": "sgd", "learningRate": 0.01},
+                "train": {"steps": STEPS, "logEvery": 1,
+                          "checkpointEvery": 2, "precision": "float32",
+                          "checkpointLocalDir": local},
+            },
+        },
+    },
+})
+uid = agent.submit(op)
+store.target = uid
+
+# the instant the evicted run frees its 2 chips, 1 is stolen — the full
+# block can never re-place, so re-admission MUST take the smaller rung
+hogged = []
+real_release = Fleet.release
+
+
+def release_and_hog(self, run_uuid):
+    rec = real_release(self, run_uuid)
+    if run_uuid == uid and not hogged:
+        hogged.append(1)
+        assert self.reserve("hog", chips=1, project="hog") is not None
+    return rec
+
+
+Fleet.release = release_and_hog
+agent.drain()
+status = store.get_status(uid)
+assert getattr(status["status"], "value", status["status"]) == "succeeded"
+meta = status["meta"]
+assert meta["granted_chips"] == 1 and meta["requested_chips"] == 2, meta
+resumed = [e for e in store.read_events(uid) if e["kind"] == "resumed"]
+assert resumed and resumed[0]["step"] >= EVICT_AT, resumed
+assert store.read_metrics(uid)[-1]["step"] == STEPS
+
+server = make_server(store, port=0)
+port = server.server_address[1]
+threading.Thread(target=server.serve_forever, daemon=True).start()
+try:
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+finally:
+    server.shutdown()
+with open("tpu_results/elastic_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "trainer_checkpoint_stall_ms",
+    "checkpoint_tier_writes_total",
+    "trainer_elastic_resizes_total",
+    "scheduler_elastic_shrinks_total",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("elastic metricsz smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"elastic metricsz smoke: ok ({len(required)} required series "
+      f"present, resumed at step {resumed[0]['step']} on 1 chip)")
+PY
+    then
+      echo "ELASTIC-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

@@ -115,3 +115,33 @@ def test_serving_bench_shared_prefix_demonstrates_reuse(tmp_home):
     assert r["prefix_hit_rate"] > 0
     assert r["ttft_warm_p50_ms"] < r["ttft_cold_ms"]
     assert r["value"] > 1.0
+
+
+def test_elastic_bench_schema(tmp_home):
+    proc = _run("benchmarks/elastic_bench.py", "--smoke")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    recs = {r["metric"]: r for r in _records(proc)}
+    for r in recs.values():
+        assert "error" not in r, r
+
+    stall = recs["checkpoint_stall_ms"]
+    # the stall numbers come from the trainer's own histogram — the same
+    # series the canary greps off /metricsz, not a bench-local clock
+    assert stall["status"] == "succeeded"
+    assert stall["boundaries"] > 0
+    assert {"stall_p50_ms", "stall_p95_ms", "stall_max_ms",
+            "tier_writes"} <= stall.keys(), stall
+    # two tiers: every boundary lands locally AND replicates durably
+    assert stall["tier_writes"] >= 2 * stall["boundaries"]
+
+    lost = recs["steps_lost_per_preemption"]
+    assert lost["preemptions"] >= 1
+    assert lost["bound_held"] is True
+    assert lost["steps_lost_max"] <= lost["checkpoint_every"]
+    assert lost["time_to_resume_ms_mean"] is not None
+
+    resize = recs["elastic_resize"]
+    assert resize["grants"][0] > resize["grants"][1]  # shrank under pressure
+    assert resize["grants"][-1] == resize["grants"][0]  # grew back
+    assert resize["elastic_wait_total_s"] == 0.0  # the ladder never parks
+    assert resize["elastic_makespan_s"] < resize["rigid_makespan_s"]
