@@ -650,10 +650,21 @@ def agent_drain(queues):
               help="disable cross-request prefix KV reuse (paged pool only)")
 @click.option("--no-stream", is_flag=True,
               help="disable POST /generate?stream=1 incremental delivery")
+@click.option("--speculate", is_flag=True,
+              help="self-speculative decoding: draft tokens from a per-row "
+                   "n-gram index and verify them in one batched window — "
+                   "outputs stay byte-identical to plain decode")
+@click.option("--draft-tokens", default=None, type=int,
+              help="drafts per speculative verify window (default 4; "
+                   "higher pays off only at high accept rates)")
+@click.option("--quantize", is_flag=True,
+              help="int8 weight-only quantize the projection kernels at "
+                   "load (per-output-channel scales; prefill/embed/lm_head "
+                   "stay full precision)")
 def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
           expected_devices, kv_pool_pages, kv_page_tokens, no_prefix_cache,
-          no_stream):
+          no_stream, speculate, draft_tokens, quantize):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
@@ -688,6 +699,10 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         overrides["prefix_cache"] = False
     if no_stream:
         overrides["stream"] = False
+    if speculate:
+        overrides["speculate"] = True
+    if quantize:
+        overrides["quantize"] = True
     for field, value in (
         ("max_batch", max_batch),
         ("max_wait_ms", max_wait_ms),
@@ -697,6 +712,7 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         ("breaker_threshold", breaker_threshold),
         ("kv_pool_pages", kv_pool_pages),
         ("kv_page_tokens", kv_page_tokens),
+        ("draft_tokens", draft_tokens),
     ):
         if value is not None:
             overrides[field] = value

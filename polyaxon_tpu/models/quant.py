@@ -1,0 +1,175 @@
+"""int8 weight-only quantization for the serving decode path (ISSUE 8).
+
+Decode is weight-bandwidth-bound: every sampled token re-reads every
+projection kernel out of HBM, so shrinking the resident kernels shrinks
+the step time ceiling directly. This module quantizes the seven
+transformer projection kernels (q/k/v/o and gate/up/down) to int8 with a
+PER-OUTPUT-CHANNEL symmetric scale:
+
+    scale[o] = max_i |W[i, o]| / 127        (float32, one per column)
+    Wq[i, o] = round(W[i, o] / scale[o])    (int8, clipped to [-127, 127])
+
+`Int8Dense` then feeds the int8 kernel STRAIGHT into
+`jax.lax.dot_general(x, Wq, preferred_element_type=f32)` — a mixed
+int8×bf16/f32 matmul, no dequantized copy of the kernel ever
+materializes in HBM — and folds the scale into the f32 accumulator
+output. Embedding, lm_head and the norms stay full precision (the
+quality-critical ends of the network), as do LoRA adapters (quantizing a
+frozen base under trainable deltas is a training concern, rejected).
+
+Quantize-on-load: serving restores the checkpoint's fp params with the
+ordinary module, calls `quantize_module()` once, and drops the dense
+tree — the fp kernels are never resident past startup. The transform is
+pure tree surgery: each targeted `{kernel}` dict gains a sibling
+`scale`, matching what `Int8Dense` (selected by
+`TransformerConfig.quant == "int8"`) reads back.
+
+No clocks in here — quantization is a load-time transform and the
+speculation/quant decode path orders everything by logical generation
+index (scripts/lint_telemetry.py pins this module clock-free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# the seven decode projections; everything else (embed, lm_head, norms,
+# lora_a/b, MoE router) stays at checkpoint precision
+QUANT_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+class Int8Dense(nn.Module):
+    """Weight-only int8 projection: int8 kernel + per-output-channel f32
+    scale, applied as one dequant-free mixed matmul. Drop-in for the
+    nn.Dense(use_bias=False) projections — same param path (`.../kernel`),
+    one extra `scale` leaf, so the sharding rules keep matching."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        kernel = self.param(
+            "kernel", lambda _, s: jnp.zeros(s, jnp.int8),
+            (in_dim, self.features),
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,)
+        )
+        # mixed int8 x activation-dtype contraction: XLA widens kernel
+        # tiles on the fly inside the matmul — the f32 accumulator comes
+        # from preferred_element_type, the dequant is the one scale
+        # multiply on the [.., features] output
+        y = jax.lax.dot_general(
+            x,
+            kernel,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (y * scale).astype(x.dtype)
+
+
+def quantize_kernel(w) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., in, out] fp kernel → (int8 kernel, f32 scale[..., out]).
+    Leading layer axes (nn.scan stacking) quantize per (layer, column)."""
+    w32 = jnp.asarray(w).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(w32 / scale[..., None, :]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _is_mapping(x: Any) -> bool:
+    return hasattr(x, "items") and not hasattr(x, "shape")
+
+
+def quantize_params(params) -> tuple[dict, int]:
+    """Quantize every QUANT_TARGETS projection kernel in a params tree.
+    Returns (new tree, HBM bytes saved). Non-target leaves pass through
+    untouched; a target that carries LoRA adapters is rejected."""
+    saved = 0
+
+    def walk(tree):
+        nonlocal saved
+        out = {}
+        for k, v in tree.items():
+            if (
+                k in QUANT_TARGETS
+                and _is_mapping(v)
+                and "kernel" in v
+            ):
+                if any(name.startswith("lora_") for name in v):
+                    raise ValueError(
+                        f"cannot int8-quantize {k!r}: it carries LoRA "
+                        "adapter params (serve the merged checkpoint "
+                        "instead)"
+                    )
+                w = jnp.asarray(v["kernel"])
+                q, s = quantize_kernel(w)
+                saved += (
+                    w.size * w.dtype.itemsize
+                    - q.size * q.dtype.itemsize
+                    - s.size * s.dtype.itemsize
+                )
+                out[k] = {"kernel": q, "scale": s}
+            elif _is_mapping(v):
+                out[k] = walk(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk(params), int(saved)
+
+
+def decode_weight_bytes(params) -> tuple[int, int]:
+    """(target projection bytes, total param bytes) — the bench's HBM
+    reduction is measured over these, not a synthetic estimate."""
+    target = total = 0
+
+    def walk(tree, in_target):
+        nonlocal target, total
+        for k, v in tree.items():
+            if _is_mapping(v):
+                walk(v, in_target or k in QUANT_TARGETS)
+            else:
+                b = v.size * v.dtype.itemsize
+                total += b
+                if in_target:
+                    target += b
+
+    walk(params, False)
+    return target, total
+
+
+def quantize_module(module, params) -> tuple[Any, dict, int]:
+    """Quantize-on-load for serving: rebuild `module` with the int8
+    projection path (`cfg.quant = "int8"`) and transform `params` to
+    match. Returns (module, params, bytes_saved)."""
+    cfg = getattr(module, "cfg", None)
+    if cfg is None or not hasattr(cfg, "quant"):
+        raise ValueError(
+            f"{type(module).__name__} has no quantizable decode path"
+        )
+    if cfg.quant != "none":
+        raise ValueError(
+            f"module is already quantized (cfg.quant = {cfg.quant!r}) — "
+            "quantize-on-load runs once, on the fp checkpoint"
+        )
+    if getattr(cfg, "lora_rank", 0) > 0:
+        raise ValueError(
+            "int8 serving does not support LoRA checkpoints — merge the "
+            "adapters into the base kernels first"
+        )
+    qparams, saved = quantize_params(params)
+    qmodule = type(module)(dataclasses.replace(cfg, quant="int8"))
+    return qmodule, qparams, saved

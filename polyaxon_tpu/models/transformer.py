@@ -53,6 +53,11 @@ class TransformerConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: tuple = ()  # projection names; empty = all projections
+    # weight-only quantized projections for the serving decode path:
+    # "int8" swaps every _proj for models/quant.Int8Dense (int8 kernel +
+    # per-output-channel scale, dequant-free mixed matmul). Set by
+    # quant.quantize_module at serving load — not a training config.
+    quant: str = "none"  # none | int8
     tie_embeddings: bool = False
     scan_layers: bool = False
     # MoE: replace the dense FFN with n_experts switch-routed experts
@@ -146,6 +151,10 @@ class LoRADense(nn.Module):
 def _proj(cfg: TransformerConfig, features: int, name: str):
     if cfg.lora_rank > 0 and (not cfg.lora_targets or name in cfg.lora_targets):
         return LoRADense(features, rank=cfg.lora_rank, alpha=cfg.lora_alpha, name=name)
+    if cfg.quant == "int8":
+        from .quant import Int8Dense
+
+        return Int8Dense(features, name=name)
     return nn.Dense(features, use_bias=False, name=name)
 
 
@@ -161,7 +170,8 @@ class Attention(nn.Module):
         decode: bool = False,
         pad=None,
         pages=None,  # [B, n_pages] page table → block-paged KV (ISSUE 6)
-        pos=None,  # traced int32 scalar: first cache slot this call writes
+        pos=None,  # traced int32 scalar — or [B] per-row vector on the
+        # speculative verify path — first cache slot this call writes
         kv_layout=None,  # kv_pages.PagedKVLayout (static pool shape)
         prefix_len: int = 0,  # static: slots [0, prefix_len) hold a shared
         # prefilled prefix; the row's own tokens start (left-padded) after it
@@ -238,7 +248,23 @@ class Attention(nn.Module):
                         )
                     pos = jnp.asarray(pos, jnp.int32)
                 else:
-                    pos = cache_index.value
+                    pos = (
+                        cache_index.value
+                        if pos is None
+                        else jnp.asarray(pos, jnp.int32)
+                    )
+                # speculative verify windows pass per-row [B] frontiers:
+                # once accept lengths diverge, rows of one group sit at
+                # different write positions, so slots/rope/mask below work
+                # over a [B, S] slot grid instead of one shared [S] row
+                per_row = pos.ndim == 1
+                if per_row and pad is None:
+                    raise ValueError(
+                        "per-row pos needs pad (bucketed-row decode)"
+                    )
+                row_slots = (
+                    pos[:, None] if per_row else pos
+                ) + jnp.arange(S)[None, :]
                 if pad is None:
                     q = apply_rope(q, cos, sin, offset=pos)
                     k = apply_rope(k, cos, sin, offset=pos)
@@ -249,9 +275,7 @@ class Attention(nn.Module):
                     # must stay in range. With a shared prefix the row's
                     # own region starts at prefix_len, so the same formula
                     # holds (writes only ever target slots >= prefix_len).
-                    positions = jnp.maximum(
-                        pos + jnp.arange(S)[None, :] - pad[:, None], 0
-                    )
+                    positions = jnp.maximum(row_slots - pad[:, None], 0)
                     q = apply_rope_at(q, cos, sin, positions)
                     k = apply_rope_at(k, cos, sin, positions)
                 if paged:
@@ -259,13 +283,19 @@ class Attention(nn.Module):
                     # slot s lives at (pages[b, s // pt], s % pt). Rows
                     # never share their WRITE pages (copy-on-write: shared
                     # prefix pages sit below pos and are read-only here).
-                    slots = pos + jnp.arange(S)
+                    # A draft window may overrun the row's table span
+                    # (slots the verify step will reject): those map to
+                    # the out-of-range page id pool_sz and the scatter
+                    # drops them, so the pool is never written past the
+                    # row's own pages.
+                    slots = jnp.broadcast_to(row_slots, (B, S))
                     pp = jnp.take_along_axis(
-                        pages, jnp.broadcast_to((slots // pt_sz)[None, :], (B, S)), axis=1
+                        pages, slots // pt_sz, axis=1,
+                        mode="fill", fill_value=pool_sz,
                     )
-                    off = jnp.broadcast_to((slots % pt_sz)[None, :], (B, S))
-                    k_all = cached_k.value.at[pp, off].set(k)
-                    v_all = cached_v.value.at[pp, off].set(v)
+                    off = slots % pt_sz
+                    k_all = cached_k.value.at[pp, off].set(k, mode="drop")
+                    v_all = cached_v.value.at[pp, off].set(v, mode="drop")
                     cached_k.value, cached_v.value = k_all, v_all
                     win = pages.shape[1] * pt_sz
                     # gather the row's whole window back out of the pool;
@@ -273,6 +303,21 @@ class Attention(nn.Module):
                     # garbage is masked dead below (slot > pos + i)
                     k_all = k_all[pages].reshape(B, win, nkv, hd)
                     v_all = v_all[pages].reshape(B, win, nkv, hd)
+                elif per_row:
+                    # rows at different frontiers: dynamic_update_slice's
+                    # shared offset no longer applies, scatter per row
+                    # instead; slots past seq_len (rejected draft tail at
+                    # the cache edge) drop harmlessly. The caller drives
+                    # pos explicitly, so cache_index is left alone.
+                    b_ix = jnp.arange(B)[:, None]
+                    k_all = cached_k.value.at[b_ix, row_slots].set(
+                        k, mode="drop"
+                    )
+                    v_all = cached_v.value.at[b_ix, row_slots].set(
+                        v, mode="drop"
+                    )
+                    cached_k.value, cached_v.value = k_all, v_all
+                    win = cfg.seq_len
                 else:
                     k_all = jax.lax.dynamic_update_slice(
                         cached_k.value, k, (0, pos, 0, 0)
@@ -295,12 +340,12 @@ class Attention(nn.Module):
                     k_all,
                     preferred_element_type=jnp.float32,
                 ).reshape(B, nh, S, win) / np.sqrt(hd)
-                # query row i may see cache positions <= pos + i
+                # query row i may see cache positions <= pos + i (with a
+                # per-row pos the comparison broadcasts to [B, S, win])
                 live = (
-                    jnp.arange(win)[None, :]
-                    <= (pos + jnp.arange(S))[:, None]
+                    jnp.arange(win)[None, None, :] <= row_slots[:, :, None]
                 )
-                mask = live[None, None, :, :]
+                mask = live[:, None, :, :]
                 if pad is not None:
                     if prefix_len:
                         # row layout: [shared prefix 0..prefix_len) |
@@ -513,7 +558,8 @@ class Transformer(nn.Module):
         return_features: bool = False,
         pad=None,  # [B] left-pad widths for bucketed decode (serving path)
         pages=None,  # [B, n_pages] page table → block-paged KV decode
-        pos=None,  # traced int32 scalar: first cache slot written this call
+        pos=None,  # traced int32 scalar (or [B] per-row speculative
+        # frontiers): first cache slot written this call
         kv_layout=None,  # kv_pages.PagedKVLayout (static pool shape)
         prefix_len: int = 0,  # static shared-prefix width (paged path)
     ):
@@ -566,7 +612,9 @@ class Transformer(nn.Module):
                 cfg, train, decode,
                 kv_layout=kv_layout, prefix_len=prefix_len, name="layers",
             )
-            if pages is not None:
+            if pages is not None or pos is not None:
+                # pos rides the 4-tuple carry on the dense speculative
+                # path too (pages is then a None leafless subtree)
                 (x, _, _, _), _ = layers((x, pad, pages, pos), None)
             elif pad is not None:
                 (x, _), _ = layers((x, pad), None)

@@ -155,9 +155,23 @@ class V1ServingSpec(BaseSchema):
     prefix_cache: bool = True
     stream: bool = True
     stream_chunk_tokens: int | str = 8
+    # fast decode (ISSUE 8): speculate enables self-speculative decoding
+    # (n-gram drafts of draftTokens verified in one batched window;
+    # outputs stay byte-identical to plain decode), quantize loads the
+    # checkpoint with int8 weight-only projection kernels
+    speculate: bool = False
+    draft_tokens: int | str = 4
+    quantize: bool = False
 
     @model_validator(mode="after")
     def _check(self):
+        if isinstance(self.draft_tokens, int) and not (
+            1 <= self.draft_tokens <= 16
+        ):
+            raise ValueError(
+                f"draftTokens must be in [1, 16] (the verify window is "
+                f"draftTokens + 1 wide), got {self.draft_tokens}"
+            )
         if isinstance(self.max_batch, int) and self.max_batch < 1:
             raise ValueError(f"maxBatch must be >= 1, got {self.max_batch}")
         if isinstance(self.kv_page_tokens, int) and self.kv_page_tokens < 1:
@@ -234,6 +248,9 @@ class V1ServingSpec(BaseSchema):
             prefix_cache=self.prefix_cache,
             stream=self.stream,
             stream_chunk_tokens=int(self.stream_chunk_tokens),
+            speculate=self.speculate,
+            draft_tokens=int(self.draft_tokens),
+            quantize=self.quantize,
         )
 
 

@@ -183,6 +183,13 @@ class ServingConfig:
     prefix_cache: bool = True
     stream: bool = True  # expose POST /generate?stream=1
     stream_chunk_tokens: int = 8  # decode steps per emitted chunk
+    # fast decode path (ISSUE 8): self-speculative verify windows of
+    # draft_tokens n-gram drafts (byte-identical outputs; sampled
+    # requests must carry per-row seeds, which serving always does) and
+    # int8 weight-only quantized projections (quantize-on-load)
+    speculate: bool = False
+    draft_tokens: int = 4
+    quantize: bool = False
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -206,6 +213,12 @@ class GroupKey:
     # paged path: rows in one group share the compiled (L, pb, nb) shape;
     # prompt_bucket then sizes the SUFFIX (tokens beyond the cached prefix)
     prefix_len: int = 0
+    # decode mode (ISSUE 8): speculative verify windows compile a
+    # different program shape, so groups must not mix modes — keying on
+    # them keeps the buckets from fragmenting any further than that
+    speculate: bool = False
+    draft_tokens: int = 0  # verify window width - 1 (0 when not speculating)
+    quantize: bool = False  # server-wide, but part of the mode signature
 
 
 @dataclasses.dataclass

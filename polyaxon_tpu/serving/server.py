@@ -147,11 +147,22 @@ class ModelServer:
         registry: Optional[MetricsRegistry] = None,
         expected_devices: Optional[int] = None,
     ):
+        self.config = config or ServingConfig()
+        # int8 quantize-on-load (ISSUE 8): rebuild the module with the
+        # Int8Dense projection path and transform the restored fp params
+        # BEFORE anything captures them — the dense projection kernels
+        # are never resident past this constructor
+        self._quant_bytes_saved = 0
+        if self.config.quantize:
+            from ..models.quant import quantize_module
+
+            module, params, self._quant_bytes_saved = quantize_module(
+                module, params
+            )
         self.module = module
         self.params = params
         self.model_name = model_name
         self.step = step
-        self.config = config or ServingConfig()
         # readiness: /readyz reports 503 while draining, and — when
         # `expected_devices` is set — when the visible device count
         # regresses below it (degraded slice; runtime/health.check_slice)
@@ -234,6 +245,29 @@ class ModelServer:
             "serving.prefix_cache_misses",
             help="Requests that found no cached KV prefix",
         )
+        # fast-decode series (ISSUE 8) — registered from startup (zeros
+        # when speculation/quant are off) so the canary's spec gate can
+        # scrape them unconditionally
+        self._m_spec_proposed = self.telemetry.counter(
+            "serving.spec_proposed",
+            help="Draft tokens proposed to speculative verify windows",
+        )
+        self._m_spec_accepted = self.telemetry.counter(
+            "serving.spec_accepted",
+            help="Draft tokens accepted (committed without their own "
+            "forward pass); accept rate = accepted / proposed",
+        )
+        self._m_spec_rollback = self.telemetry.counter(
+            "serving.spec_rollback",
+            help="Draft tokens rejected and rolled back (their KV slots "
+            "are masked dead and rewritten by the next window)",
+        )
+        self._m_quant_saved = self.telemetry.gauge(
+            "serving.quant_bytes_saved",
+            help="HBM bytes saved by int8 weight-only quantization "
+            "(0 = full-precision kernels)",
+        )
+        self._m_quant_saved.set(self._quant_bytes_saved)
         self._m_ttft = self.telemetry.histogram(
             "serving.ttft_ms",
             buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
@@ -614,6 +648,16 @@ class ModelServer:
         scalar-seed legacy path had the same property via shared-batch
         sampling)."""
         cfg = self.module.cfg
+        # decode mode (ISSUE 8): constant per server, but part of the
+        # group signature so mixed-mode groups can never form (and the
+        # compiled-program keys below inherit it via the key fields)
+        mode = dict(
+            speculate=bool(self.config.speculate),
+            draft_tokens=(
+                int(self.config.draft_tokens) if self.config.speculate else 0
+            ),
+            quantize=bool(self.config.quantize),
+        )
         out = []
         try:
             for i, row in enumerate(req["arr"]):
@@ -636,6 +680,7 @@ class ModelServer:
                         top_k=req["top_k"],
                         eos_id=req["eos_id"],
                         prefix_len=plan.prefix_len,
+                        **mode,
                     )
                 else:
                     pb, nb = choose_buckets(
@@ -651,6 +696,7 @@ class ModelServer:
                         temperature=req["temperature"],
                         top_k=req["top_k"],
                         eos_id=req["eos_id"],
+                        **mode,
                     )
                 r = PendingRequest(
                     tokens=row.tolist(),
@@ -735,6 +781,277 @@ class ModelServer:
             r.finish(
                 result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
             )
+        self._m_requests.inc(n)
+
+    # ------------------------------------------------- speculative decode
+    def _spec_prefill_fn(self, bb, pb, temperature, top_k):
+        from ..models.spec_decode import jit_spec_prefill
+
+        key = ("spec_prefill", bb, pb, temperature, top_k)
+        return self._cached(
+            key,
+            lambda: jit_spec_prefill(
+                self.module, temperature=temperature, top_k=top_k
+            ),
+        )
+
+    def _spec_verify_fn(self, bb, draft_tokens, temperature, top_k, eos_id):
+        from ..models.spec_decode import jit_spec_verify
+
+        key = ("spec_verify", bb, draft_tokens, temperature, top_k, eos_id)
+        return self._cached(
+            key,
+            lambda: jit_spec_verify(
+                self.module,
+                temperature=temperature,
+                top_k=top_k,
+                eos_id=eos_id,
+            ),
+        )
+
+    def _spec_verify_paged_fn(
+        self, bb, draft_tokens, prefix_len, n_pages, temperature, top_k,
+        eos_id,
+    ):
+        from ..models.spec_decode import jit_spec_verify_paged
+
+        key = (
+            "spec_verify_paged", bb, draft_tokens, prefix_len, n_pages,
+            temperature, top_k, eos_id,
+        )
+        return self._cached(
+            key,
+            lambda: jit_spec_verify_paged(
+                self.module,
+                kv_layout=self._kv.layout,
+                prefix_len=prefix_len,
+                temperature=temperature,
+                top_k=top_k,
+                eos_id=eos_id,
+            ),
+        )
+
+    def _spec_observe(self, stats: dict) -> None:
+        self._m_spec_proposed.inc(int(stats.get("proposed", 0)))
+        self._m_spec_accepted.inc(int(stats.get("accepted", 0)))
+        self._m_spec_rollback.inc(int(stats.get("rollback", 0)))
+
+    def _execute_group_spec(self, batch: list[PendingRequest]):
+        """Dense-cache speculative group: same bucketed shapes and
+        byte-identical outputs as _execute_group, but the decode loop is
+        models/spec_decode.spec_generate — n-gram drafts, one verify
+        window per K+1 tokens, per-row accept lengths."""
+        import time as _time
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.spec_decode import spec_generate
+
+        key = batch[0].key
+        n = len(batch)
+        inject("serving.slow", rows=n)
+        inject("serving.decode", rows=n)
+        qnow = _time.monotonic()
+        for r in batch:
+            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+        self._m_occupancy.observe(n)
+        self._m_batches.inc()
+        P, N = key.prompt_bucket, key.new_bucket
+        bb = batch_bucket(n, max(n, self.config.max_batch))
+        arr = np.zeros((bb, P), np.int32)
+        lengths = np.ones((bb,), np.int32)
+        seeds = np.zeros((bb,), np.int32)
+        for i, r in enumerate(batch):
+            arr[i, P - r.prompt_len:] = r.tokens
+            lengths[i] = r.prompt_len
+            seeds[i] = r.seed
+        stats: dict = {}
+        with self._lock:
+            prefill_fn = self._spec_prefill_fn(
+                bb, P, key.temperature, key.top_k
+            )
+            verify_fn = self._spec_verify_fn(
+                bb, key.draft_tokens, key.temperature, key.top_k, key.eos_id
+            )
+            out = np.asarray(
+                spec_generate(
+                    self.module,
+                    self.params,
+                    jnp.asarray(arr),
+                    max_new_tokens=N,
+                    draft_tokens=key.draft_tokens,
+                    temperature=key.temperature,
+                    top_k=key.top_k,
+                    eos_id=key.eos_id,
+                    seeds=seeds,
+                    prompt_lengths=lengths,
+                    prefill_fn=prefill_fn,
+                    verify_fn=verify_fn,
+                    stats=stats,
+                )
+            )
+        self._spec_observe(stats)
+        for i, r in enumerate(batch):
+            pad = P - r.prompt_len
+            if r.t0 is not None:
+                self._m_ttft.observe((_now() - r.t0) * 1e3)
+            r.finish(
+                result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
+            )
+        self._m_requests.inc(n)
+
+    def _execute_group_paged_spec(self, batch: list[PendingRequest]):
+        """Paged speculative group: _execute_group_paged's admission,
+        prefill, streaming, and harvest, with the chunk loop replaced by
+        verify windows (jit_spec_verify_paged). Rows accept different
+        lengths, so the write frontier and generation index are per-row
+        vectors, and each window streams exactly the tokens it committed.
+        Outputs stay byte-identical to the non-speculative paged path."""
+        import time as _time
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.spec_decode import NgramDrafter, commit_window
+
+        kv = self._kv
+        key = batch[0].key
+        n = len(batch)
+        K = int(key.draft_tokens)
+        inject("serving.slow", rows=n)
+        inject("serving.decode", rows=n)
+        qnow = _time.monotonic()
+        for r in batch:
+            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+        self._m_occupancy.observe(n)
+        self._m_batches.inc()
+        L, pb, nb = key.prefix_len, key.prompt_bucket, key.new_bucket
+        n_pages = kv.layout.pages_for(L + pb + nb - 1)
+        bb = batch_bucket(n, max(n, self.config.max_batch))
+        plans = [r.kv_plan for r in batch] + [None] * (bb - n)
+        arr = np.zeros((bb, pb), np.int32)
+        pads = np.full((bb,), pb - 1, np.int32)
+        seeds = np.zeros((bb,), np.int32)
+        for i, r in enumerate(batch):
+            sfx = r.tokens[L:]
+            arr[i, pb - len(sfx):] = sfx
+            pads[i] = pb - len(sfx)
+            seeds[i] = r.seed
+        kv.ensure_pages(plans[:n], upto_slot=L + pb)
+        tables = kv.tables(plans, bb, n_pages)
+        with self._lock:
+            fn = self._paged_prefill_fn(
+                bb, pb, L, n_pages, key.temperature, key.top_k
+            )
+            kv.cache, first = fn(
+                self.params,
+                kv.cache,
+                jnp.asarray(arr),
+                jnp.asarray(pads),
+                jnp.asarray(tables),
+                jnp.asarray(seeds),
+            )
+        first_np = np.asarray(first)
+        tnow = _now()
+        gen = [[int(first_np[i])] for i in range(n)]
+        for i, r in enumerate(batch):
+            r.first_token_at = tnow
+            if r.t0 is not None:
+                self._m_ttft.observe((tnow - r.t0) * 1e3)
+            if r.on_tokens is not None:
+                try:
+                    r.on_tokens([int(first_np[i])])
+                except Exception:  # noqa: BLE001 — a dead client stays local
+                    pass
+
+        def emit(i, fresh):
+            gen[i].extend(int(t) for t in fresh)
+            if len(fresh) and batch[i].on_tokens is not None:
+                try:
+                    batch[i].on_tokens([int(t) for t in fresh])
+                except Exception:  # noqa: BLE001
+                    pass
+
+        # per-row loop state: drafters over the FULL prompt (prefix
+        # included — that's where the repetitive material usually is),
+        # write frontier `pos`, generation index `start_g`
+        drafters = [
+            NgramDrafter(batch[i].tokens + [int(first_np[i])])
+            for i in range(n)
+        ]
+        tok = np.zeros((bb,), np.int32)
+        tok[:n] = first_np[:n]
+        pos = np.full((bb,), L + pb, np.int64)
+        start_g = np.ones((bb,), np.int64)
+        done = np.zeros((bb,), bool)
+        remaining = np.zeros((bb,), np.int64)
+        for i, r in enumerate(batch):
+            remaining[i] = r.max_new - 1
+            if key.eos_id is not None and first_np[i] == key.eos_id:
+                # everything after a generated eos is pinned: emit the
+                # rest host-side and retire the row
+                emit(i, [int(key.eos_id)] * int(remaining[i]))
+                remaining[i] = 0
+        totals = {"proposed": 0, "accepted": 0, "rollback": 0}
+        while (remaining > 0).any():
+            fed = np.empty((bb, K + 1), np.int32)
+            fed[:, 0] = tok
+            for b in range(bb):
+                fed[b, 1:] = (
+                    drafters[b].propose(K)
+                    if b < n and remaining[b] > 0
+                    else tok[b]
+                )
+            frontier = int(pos[:n].max()) + K + 1
+            kv.ensure_pages(plans[:n], upto_slot=frontier)
+            tables = kv.tables(plans, bb, n_pages)
+            with self._lock:
+                fn = self._spec_verify_paged_fn(
+                    bb, K, L, n_pages, key.temperature, key.top_k,
+                    key.eos_id,
+                )
+                kv.cache, targets, accept = fn(
+                    self.params,
+                    kv.cache,
+                    jnp.asarray(fed),
+                    jnp.asarray(done),
+                    jnp.asarray(pads),
+                    jnp.asarray(tables),
+                    jnp.asarray(seeds),
+                    jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(start_g, jnp.int32),
+                )
+            committed, done, remaining, eos_hit, delta = commit_window(
+                fed, targets, accept, remaining, done, key.eos_id
+            )
+            for k in totals:
+                totals[k] += delta[k]
+            for i in range(n):
+                toks = committed[i]
+                if not len(toks):
+                    continue
+                emit(i, toks)
+                drafters[i].extend(toks)
+                tok[i] = toks[-1]
+                pos[i] += len(toks)
+                start_g[i] += len(toks)
+                if eos_hit[i] and remaining[i] > 0:
+                    emit(i, [int(key.eos_id)] * int(remaining[i]))
+                    remaining[i] = 0
+        self._spec_observe(totals)
+        try:
+            with self._lock:  # harvest donates the pool buffer too
+                kv.harvest(
+                    [
+                        (r.tokens, r.kv_plan, int(pads[i]))
+                        for i, r in enumerate(batch)
+                    ]
+                )
+        except Exception:  # noqa: BLE001 — cache warmth must not fail rows
+            pass
+        for i, r in enumerate(batch):
+            r.finish(result=list(r.tokens) + gen[i][: r.max_new])
         self._m_requests.inc(n)
 
     def _paged_prefill_fn(self, bb, pb, prefix_len, n_pages, temperature, top_k):
@@ -936,10 +1253,16 @@ class ModelServer:
         self._m_requests.inc(len(batch))
 
     def _dispatch_group(self, batch: list[PendingRequest]):
-        if batch[0].key.num_beams > 1:
+        key = batch[0].key
+        if key.num_beams > 1:
             self._execute_beam_group(batch)
         elif self._kv is not None and batch[0].kv_plan is not None:
-            self._execute_group_paged(batch)
+            if key.speculate:
+                self._execute_group_paged_spec(batch)
+            else:
+                self._execute_group_paged(batch)
+        elif key.speculate:
+            self._execute_group_spec(batch)
         else:
             self._execute_group(batch)
 
@@ -1183,8 +1506,26 @@ class ModelServer:
                     for k in ("p50", "p95", "p99", "mean")
                 },
             }
+        proposed = int(self._m_spec_proposed.value)
+        accepted = int(self._m_spec_accepted.value)
+        speculation = {
+            "enabled": bool(self.config.speculate),
+            "draft_tokens": int(self.config.draft_tokens),
+            "proposed": proposed,
+            "accepted": accepted,
+            "rollbacks": int(self._m_spec_rollback.value),
+            "accept_rate": (
+                round(accepted / proposed, 4) if proposed else None
+            ),
+        }
+        quant = {
+            "enabled": bool(self.config.quantize),
+            "bytes_saved": int(self._quant_bytes_saved),
+        }
         return {
             "kv": kv,
+            "speculation": speculation,
+            "quant": quant,
             **resilience,
             "batching": bool(self.config.batching),
             "compile_count": self.compile_count,

@@ -41,6 +41,18 @@ clock that can disagree with the histogram the canary gates on, so any
 `time.time/monotonic/perf_counter` (and `_ns` variants) there is
 forbidden.
 
+Sixth rule: NO raw clock in the fast-decode modules. Speculative
+decoding (`polyaxon_tpu/models/spec_decode.py`) orders drafting, verify
+and commit purely by logical generation index — the per-row key
+schedule `fold_in(key, g)` is what makes speculative output
+byte-identical to plain decode, and a wall-clock read anywhere in that
+path is a tell that something (drafter pruning, window sizing) has been
+coupled to host timing and replay just broke. Weight-only quantization
+(`polyaxon_tpu/models/quant.py`) is a load-time tree transform with no
+duration of its own; its one observable (bytes saved) is a counter, not
+a latency. Any `time.time/monotonic/perf_counter` (and `_ns` variants)
+in either module is forbidden — logical generation index only.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -70,6 +82,13 @@ CKPT_PATTERN = re.compile(
 CKPT_MODULES = (
     ("polyaxon_tpu", "runtime", "checkpoint.py"),
 )
+SPEC_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter)(?:_ns)?\s*\("
+)
+SPEC_MODULES = (
+    ("polyaxon_tpu", "models", "spec_decode.py"),
+    ("polyaxon_tpu", "models", "quant.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -84,6 +103,7 @@ def violations(repo_root: Path) -> list[str]:
         in_serving = rel.parts[:2] == ("polyaxon_tpu", "serving")
         in_kv = rel.parts in KV_MODULES
         in_ckpt = rel.parts in CKPT_MODULES
+        in_spec = rel.parts in SPEC_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -111,6 +131,12 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: raw clock in checkpoint-tier/elastic "
                     f"accounting — order by step number; durations go "
                     f"through the trainer's telemetry spans: {line.strip()}"
+                )
+            if in_spec and SPEC_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in the fast-decode path — "
+                    f"speculation/quant order by logical generation "
+                    f"index only: {line.strip()}"
                 )
     return out
 

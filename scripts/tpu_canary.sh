@@ -270,6 +270,92 @@ PY
       echo "KV-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # fast-decode gate: warm greedy traffic (a cyclic prompt posted
+    # twice) through a speculative + int8-quantized paged server, then
+    # require the spec/quant series on /metricsz AND at least one
+    # ACCEPTED draft token on /statsz. A speculation deployment that
+    # never accepts is pure verify overhead, and a dark accept-rate
+    # cannot be tuned, so either FAILS the canary.
+    echo "running spec/quant metricsz smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+server = ModelServer(
+    b.module, params,
+    config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                         kv_pool_pages=64, kv_page_tokens=8,
+                         stream_chunk_tokens=4,
+                         speculate=True, draft_tokens=4, quantize=True),
+)
+port = server.start(port=0)
+try:
+    # a repetitive prompt is the n-gram drafter's home turf: greedy
+    # decode revisits prompt n-grams, so drafts get accepted
+    body = json.dumps({
+        "tokens": [list(range(1, 9)) * 3], "maxNewTokens": 24,
+        "temperature": 0.0, "seed": 0,
+    }).encode()
+    for _ in range(2):  # second post rides the warm prefix pages
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=300).read()
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statsz", timeout=30
+    ).read())
+finally:
+    server.stop()
+with open("tpu_results/spec_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "serving_spec_proposed_total",
+    "serving_spec_accepted_total",
+    "serving_spec_rollback_total",
+    "serving_quant_bytes_saved",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("spec/quant metricsz smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+sp = stats["speculation"]
+if sp["accepted"] < 1:
+    print("spec/quant metricsz smoke: no draft token accepted on warm "
+          "repetitive traffic", sp)
+    sys.exit(1)
+if stats["quant"]["bytes_saved"] <= 0:
+    print("spec/quant metricsz smoke: quantize-on-load saved no bytes",
+          stats["quant"])
+    sys.exit(1)
+print(f"spec/quant metricsz smoke: ok ({len(required)} required series "
+      f"present, {sp['accepted']} draft tokens accepted, "
+      f"accept_rate={sp['accept_rate']}, "
+      f"{stats['quant']['bytes_saved']} bytes saved)")
+PY
+    then
+      echo "SPEC-QUANT-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     # elastic gate: a seeded preempt-shrink-resume through the REAL stack
     # (two-tier checkpoints, eviction at peak, halving-ladder re-admission
     # on a half-stolen fleet), then require the elastic series on

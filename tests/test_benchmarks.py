@@ -70,6 +70,42 @@ def test_decode_bench_schema(tmp_home):
     # report-only on CPU (XLA ignores donation there), asserted on TPU
     assert isinstance(p["cache_donated"], bool)
 
+    # ISSUE 8: the speculation record — byte-identity is asserted inside
+    # the bench itself; here the contract keys and the acceptance claim
+    # (>= 1.3x on the copy-friendly workload) are pinned
+    spec = [
+        r for r in recs if r["metric"] == "speculative_decode_tokens_per_sec"
+    ]
+    assert len(spec) == 1, recs
+    s = spec[0]
+    assert {
+        "value", "unit", "draft_tokens", "accept_rate", "tokens_per_step",
+        "baseline_tokens_per_sec", "speedup_vs_baseline",
+        "compiled_programs", "identical_to_baseline",
+    } <= s.keys(), s
+    assert s["identical_to_baseline"] is True
+    assert s["accept_rate"] > 0.5, s  # the drafter really tracked the cycle
+    assert s["tokens_per_step"] > 1.0, s
+    assert s["speedup_vs_baseline"] >= 1.3, s
+    # the whole run compiles exactly one prefill + one verify program —
+    # the ladder the serving compile cache keys on stays flat
+    assert s["compiled_programs"] == 2
+
+    # ISSUE 8: the int8 record — >= 40% decode-weight HBM reduction with
+    # the greedy top-1 agreement bound
+    q = [r for r in recs if r["metric"] == "int8_decode_tokens_per_sec"]
+    assert len(q) == 1, recs
+    q = q[0]
+    assert {
+        "value", "unit", "decode_weight_bytes_fp", "decode_weight_bytes_int8",
+        "hbm_reduction", "top1_agreement", "logit_max_abs_delta",
+        "baseline_tokens_per_sec",
+    } <= q.keys(), q
+    assert q["decode_weight_bytes_int8"] < q["decode_weight_bytes_fp"]
+    assert q["hbm_reduction"] >= 0.40, q
+    assert q["top1_agreement"] >= 0.75, q
+    assert q["logit_max_abs_delta"] < 1.0, q
+
 
 def test_serving_bench_paged_schema(tmp_home):
     proc = _run(
@@ -115,6 +151,39 @@ def test_serving_bench_shared_prefix_demonstrates_reuse(tmp_home):
     assert r["prefix_hit_rate"] > 0
     assert r["ttft_warm_p50_ms"] < r["ttft_cold_ms"]
     assert r["value"] > 1.0
+
+
+def test_serving_bench_speculate_schema(tmp_home):
+    proc = _run(
+        "benchmarks/serving_bench.py", "--smoke", "--speculate",
+        "--kv-pool-pages", "96",
+    )
+    # rc=1 is the script's own "no drafts accepted / outputs diverged"
+    # signal — fail loudly
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    recs = {r["metric"]: r for r in _records(proc)}
+
+    s = recs["serving_speculative_speedup"]
+    assert {
+        "value", "unit", "tokens_per_sec", "baseline_tokens_per_sec",
+        "accept_rate", "tokens_per_step", "draft_tokens", "proposed",
+        "accepted", "rollbacks", "compile_count", "identical_outputs",
+    } <= s.keys(), s
+    assert s["identical_outputs"] is True
+    assert s["accepted"] > 0 and s["accept_rate"] > 0
+    assert s["tokens_per_step"] > 1.0
+    # the mode adds exactly two programs (spec prefill + verify) per
+    # bucket signature — one traffic shape means a flat compile ladder
+    assert s["compile_count"] <= 4, s
+
+    q = recs["serving_quant_bytes_saved"]
+    assert {
+        "value", "unit", "hbm_reduction", "top1_agreement_vs_fp",
+        "agreement_horizon", "tokens_per_sec", "fp_tokens_per_sec",
+    } <= q.keys(), q
+    assert q["value"] > 0 and q["unit"] == "bytes"
+    assert q["hbm_reduction"] >= 0.40
+    assert 0.0 <= q["top1_agreement_vs_fp"] <= 1.0
 
 
 def test_elastic_bench_schema(tmp_home):

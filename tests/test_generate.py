@@ -251,3 +251,136 @@ def test_beam_search_keeps_finished_hypotheses():
                     length_penalty=0.0, eos_id=EOS)
     )
     assert out[0, 1] == 1 and out[0, 2] == EOS, out
+
+
+# ---------- ISSUE 8: speculative decoding ----------
+
+
+def test_spec_generate_byte_identical_greedy():
+    """The speculation contract: spec_generate is a drop-in for
+    generate() — same tokens, byte for byte, regardless of how many
+    drafts were accepted or rolled back along the way."""
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup()
+    base = generate(module, params, prompt, max_new_tokens=10,
+                    temperature=0.0)
+    stats = {}
+    out = spec_generate(module, params, prompt, max_new_tokens=10,
+                        draft_tokens=4, temperature=0.0, stats=stats)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+    assert stats["windows"] >= 1 and stats["proposed"] > 0
+
+
+def test_spec_generate_byte_identical_sampled_bucketed_eos():
+    """The serving shape: per-row seeds, LEFT-padded rows of different
+    true lengths, eos cutoff — rows accept different window lengths and
+    still replay the exact baseline sample stream."""
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup()
+    seeds = jnp.asarray([3, 11], jnp.int32)
+    lengths = jnp.asarray([5, 3], jnp.int32)
+    base = generate(module, params, prompt, max_new_tokens=12,
+                    temperature=0.9, top_k=20, eos_id=5, seed=seeds,
+                    prompt_lengths=lengths)
+    out = spec_generate(module, params, prompt, max_new_tokens=12,
+                        draft_tokens=4, temperature=0.9, top_k=20,
+                        eos_id=5, seeds=seeds, prompt_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+def test_spec_sampled_requires_per_row_seeds():
+    """The scalar-seed stream keys on absolute position and draws one
+    batch-wide categorical — not replayable once rows accept different
+    lengths, so spec_generate must refuse rather than silently diverge."""
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup()
+    with pytest.raises(ValueError, match="per-row seeds"):
+        spec_generate(module, params, prompt, max_new_tokens=6,
+                      temperature=0.8)
+
+
+def test_spec_accepts_drafts_on_repetitive_prompt():
+    """The n-gram drafter earns its keep on repetitive input: greedy
+    decode of a cyclic prompt must accept at least one draft token
+    (accept rate strictly positive, not just progress-by-fallback)."""
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, _ = _setup()
+    prompt = jnp.asarray(
+        np.tile(np.arange(1, 9, dtype=np.int32), (2, 4))
+    )
+    base = generate(module, params, prompt, max_new_tokens=24,
+                    temperature=0.0)
+    stats = {}
+    out = spec_generate(module, params, prompt, max_new_tokens=24,
+                        draft_tokens=4, temperature=0.0, stats=stats)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+    assert stats["accepted"] > 0, stats
+    assert stats["rollback"] + stats["accepted"] <= stats["proposed"]
+
+
+# ---------- ISSUE 8: int8 weight-only quantization ----------
+
+
+def test_int8_quantize_bytes_and_greedy_agreement():
+    """Quantize-on-load must cut the decode-weight footprint by >= 40%
+    (int8 kernel + f32 per-channel scale vs the f32 original is ~74%;
+    40% is the floor that still holds for bf16 checkpoints) and greedy
+    decode through the int8 projections must track the fp model."""
+    from polyaxon_tpu.models.quant import decode_weight_bytes, quantize_module
+
+    module, params, prompt = _setup()
+    target_fp, total = decode_weight_bytes(params)
+    assert 0 < target_fp <= total
+    qmodule, qparams, saved = quantize_module(module, params)
+    assert saved / target_fp >= 0.40, (saved, target_fp)
+    base = np.asarray(
+        generate(module, params, prompt, max_new_tokens=8, temperature=0.0)
+    )
+    q = np.asarray(
+        generate(qmodule, qparams, prompt, max_new_tokens=8, temperature=0.0)
+    )
+    agree = (base[:, 5:] == q[:, 5:]).mean()
+    assert agree >= 0.75, f"int8 greedy agreement {agree}"
+    # int8 params really are int8 on the wire
+    leaves = jax.tree_util.tree_leaves_with_path(qparams)
+    kinds = {
+        str(p[-1].key): l.dtype
+        for p, l in leaves
+        if "q_proj" in str(p)
+    }
+    assert kinds["kernel"] == jnp.int8 and kinds["scale"] == jnp.float32
+
+
+@pytest.mark.slow
+def test_int8_scan_layers_and_spec_compose():
+    """scan_layers stacks kernels with a leading layer axis — the
+    per-output-channel amax must ignore it; and the quantized module
+    must still satisfy the speculative byte-identity contract (verify
+    windows run through Int8Dense like any other forward)."""
+    from polyaxon_tpu.models.quant import quantize_module
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup(scan_layers=True)
+    qmodule, qparams, saved = quantize_module(module, params)
+    assert saved > 0
+    base = generate(qmodule, qparams, prompt, max_new_tokens=8,
+                    temperature=0.0)
+    out = spec_generate(qmodule, qparams, prompt, max_new_tokens=8,
+                        draft_tokens=4, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+def test_int8_rejects_lora_and_double_quantize():
+    from polyaxon_tpu.models.quant import quantize_module
+
+    module, params, _ = _setup(lora_rank=2, lora_targets=("q_proj",))
+    with pytest.raises(ValueError, match="LoRA"):
+        quantize_module(module, params)
+    module, params, _ = _setup()
+    qmodule, qparams, _ = quantize_module(module, params)
+    with pytest.raises(ValueError, match="quant"):
+        quantize_module(qmodule, qparams)

@@ -178,6 +178,112 @@ def test_no_leaked_pages_after_traffic(servers):
     assert st["pages_used"] >= 1
 
 
+def test_speculative_servers_byte_identical_over_http(servers):
+    """ISSUE 8: ServingConfig(speculate=True) must be invisible in the
+    payload — dense AND paged speculative servers return exactly the
+    baseline servers' tokens, streamed and not, including a warm re-post
+    whose shared prefix was prefilled by the earlier request."""
+    module, params = servers["module"], servers["params"]
+    spec_d = _server(module, params, speculate=True, draft_tokens=4)
+    spec_p = _server(module, params, kv_pool_pages=64, speculate=True,
+                     draft_tokens=4)
+    pd, pp = spec_d.start(port=0), spec_p.start(port=0)
+    try:
+        prompts, body = _body(seed=888)
+        s1, o1 = _post(servers["dense"], body)
+        s2, o2 = _post(pd, body)
+        assert s1 == 200 and s2 == 200, (s1, s2, o1, o2)
+        assert json.loads(o1)["tokens"] == json.loads(o2)["tokens"]
+        s3, o3 = _post(servers["paged"], body)
+        s4, o4 = _post(pp, body)
+        assert s3 == 200 and s4 == 200, (s3, s4, o3, o4)
+        full = json.loads(o4)["tokens"]
+        assert json.loads(o3)["tokens"] == full
+
+        # warm re-post: the shared prefix was prefilled (and harvested)
+        # by the request above — hit rate grows, tokens stay identical
+        st0 = json.loads(_get(pp, "/statsz"))["kv"]
+        s5, o5 = _post(pp, body)
+        assert s5 == 200 and json.loads(o5)["tokens"] == full
+        st1 = json.loads(_get(pp, "/statsz"))["kv"]
+        assert st1["prefix"]["hits"] > st0["prefix"]["hits"]
+
+        # streamed speculative decode delivers the same tokens in chunks
+        c = http.client.HTTPConnection("127.0.0.1", pp, timeout=120)
+        c.request("POST", "/generate?stream=1", json.dumps(body))
+        r = c.getresponse()
+        assert r.status == 200
+        chunks = {i: [] for i in range(len(prompts))}
+        buf, events = b"", []
+        while True:
+            data = r.read(64)
+            if not data:
+                break
+            buf += data
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                ev = json.loads(frame[len(b"data: "):])
+                events.append(ev)
+                if "row" in ev and "tokens" in ev:
+                    chunks[ev["row"]].extend(ev["tokens"])
+        c.close()
+        assert events[-1] == {"done": True}
+        assert not any("error" in ev for ev in events), events
+        for i, p in enumerate(prompts):
+            assert p + chunks[i] == full[i], (i, chunks[i], full[i])
+
+        # greedy too (the high-acceptance regime)
+        g = dict(body, temperature=0.0)
+        _, og = _post(servers["paged"], g)
+        _, ogs = _post(pp, g)
+        assert json.loads(og)["tokens"] == json.loads(ogs)["tokens"]
+
+        # the new observability surface: counters on /metricsz, the
+        # speculation block (with actual proposals) on /statsz
+        m = _get(pp, "/metricsz").decode()
+        for series in (
+            "serving_spec_proposed_total",
+            "serving_spec_accepted_total",
+            "serving_spec_rollback_total",
+            "serving_quant_bytes_saved",
+        ):
+            assert series in m, f"missing {series} on /metricsz"
+        sp = json.loads(_get(pp, "/statsz"))["speculation"]
+        assert sp["enabled"] and sp["draft_tokens"] == 4
+        assert sp["proposed"] > 0 and sp["accept_rate"] is not None
+
+        # no leaked pages once speculative traffic drains
+        st = json.loads(_get(pp, "/statsz"))["kv"]
+        assert st["active_rows"] == 0 and st["pages_reserved"] == 0
+    finally:
+        spec_d.stop()
+        spec_p.stop()
+
+
+def test_quantized_server_serves_and_reports_footprint(servers):
+    """ISSUE 8: quantize-on-load — the server quantizes the fp params in
+    __init__, serves greedy traffic, and reports the saved bytes on both
+    /statsz and /metricsz."""
+    module, params = servers["module"], servers["params"]
+    q = _server(module, params, quantize=True)
+    port = q.start(port=0)
+    try:
+        _, body = _body(seed=999)
+        st, o = _post(port, dict(body, temperature=0.0))
+        assert st == 200, o
+        toks = json.loads(o)["tokens"]
+        assert all(
+            len(t) == len(p) + body["maxNewTokens"]
+            for t, p in zip(toks, body["tokens"])
+        )
+        stats = json.loads(_get(port, "/statsz"))["quant"]
+        assert stats["enabled"] and stats["bytes_saved"] > 0
+        m = _get(port, "/metricsz").decode()
+        assert "serving_quant_bytes_saved" in m
+    finally:
+        q.stop()
+
+
 def test_pool_exhaustion_sheds_503_without_crashing():
     module, params = _build()
     # pool 4 = scratch + 3 usable; an 8-token prompt + 4 new reserves 2
