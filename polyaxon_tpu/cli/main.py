@@ -147,20 +147,105 @@ def check(fpath):
     click.echo(json.dumps(compiled.to_dict(), indent=1, default=str))
 
 
+def _http_json(url, timeout=10.0):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except ValueError:
+            payload = {}
+        raise click.ClickException(
+            f"{url} -> HTTP {e.code}: {payload.get('error', e.reason)}"
+        )
+    except (urllib.error.URLError, OSError) as e:
+        raise click.ClickException(f"cannot reach {url}: {e}")
+
+
+def _echo_slo(slo: dict):
+    if not slo.get("enabled"):
+        click.echo("slo: no objectives configured")
+        return
+    click.echo(
+        "slo: " + ("BREACHED" if slo.get("breached") else "ok")
+    )
+    for s in slo.get("slos", []):
+        windows = " ".join(
+            f"{w}={b:.2f}x"
+            for w, b in (s.get("burn_rates") or {}).items()
+        )
+        click.echo(
+            f"  {s['name']:<20} {s.get('kind', '?'):<13} "
+            f"objective={s.get('objective')}  "
+            f"burn={s.get('burn_rate', 0):.2f}x "
+            f"[{windows}]  bad/total={s.get('bad', 0):g}/"
+            f"{s.get('total', 0):g}"
+            + ("  BREACHED" if s.get("breached") else "")
+        )
+
+
+def _echo_trace_list(url: str, n: int, sort: str):
+    data = _http_json(f"{url}/tracez?n={n}&sort={sort}")
+    click.echo(
+        f"traces: {data.get('retained', 0)} retained "
+        f"({data.get('errors', 0)} errors kept, sort={sort})"
+    )
+    for t in data.get("traces", []):
+        click.echo(
+            f"  {t['id']:<34} {t.get('status', '?'):<18} "
+            f"{t.get('dur_ms', 0):9.2f} ms  {t.get('spans', 0)} spans"
+        )
+
+
 @cli.command()
-@click.argument("run_ref")
+@click.argument("run_ref", required=False)
 @click.option("--spans", "n_spans", default=12, show_default=True,
               help="recent telemetry spans to show")
 @click.option("--events", "n_events", default=6, show_default=True,
               help="recent lifecycle events to show")
-def stats(run_ref, n_spans, n_events):
+@click.option("--url", default=None,
+              help="live server base URL (http://host:port): read /statsz "
+                   "from the serving surface instead of the run store")
+@click.option("--slo", "show_slo", is_flag=True,
+              help="with --url: show SLO burn rates (/sloz)")
+@click.option("--traces", "n_traces", default=None, type=int,
+              help="with --url: list the N most recent request traces "
+                   "(/tracez)")
+def stats(run_ref, n_spans, n_events, url, show_slo, n_traces):
     """Live metrics and recent spans of a run, from the run store.
 
     Metrics fold to their latest value (training and sys.* monitor
     samples interleave in one stream); spans come from the trainer's
-    telemetry export (<outputs>/telemetry/spans.jsonl)."""
+    telemetry export (<outputs>/telemetry/spans.jsonl). With --url the
+    serving surfaces are read instead: /statsz, plus /sloz (--slo) and
+    /tracez (--traces N)."""
     from ..store.local import UnknownRunError
 
+    if url:
+        url = url.rstrip("/")
+        stats = _http_json(f"{url}/statsz")
+        click.echo(json.dumps(
+            {k: v for k, v in stats.items() if k not in ("slo", "tracing")},
+            indent=1, default=str,
+        ))
+        tracing = stats.get("tracing") or {}
+        click.echo(
+            f"tracing: {'on' if tracing.get('enabled') else 'off'} "
+            f"({tracing.get('retained', 0)} traces retained)"
+        )
+        if show_slo:
+            _echo_slo(stats.get("slo") or _http_json(f"{url}/sloz"))
+        if n_traces:
+            _echo_trace_list(url, n_traces, "recent")
+        return
+    if show_slo or n_traces:
+        raise click.ClickException("--slo/--traces need --url (live server)")
+    if not run_ref:
+        raise click.ClickException("pass a RUN_REF or --url")
     store = RunStore()
     try:
         uuid = store.resolve(run_ref)
@@ -268,6 +353,44 @@ def stats(run_ref, n_spans, n_events):
                 f"  {ev.get('kind', '?'):<20} "
                 f"{json.dumps(body, default=str)[:120]}"
             )
+
+
+@cli.command()
+@click.argument("trace_id", required=False)
+@click.option("--url", default="http://127.0.0.1:8601", show_default=True,
+              help="live server base URL")
+@click.option("-n", "n_traces", default=20, show_default=True,
+              help="traces to list (no TRACE_ID)")
+@click.option("--sort", default="recent", show_default=True,
+              type=click.Choice(["recent", "slowest", "errors"]),
+              help="list order (no TRACE_ID)")
+def trace(trace_id, url, n_traces, sort):
+    """Inspect a serving request trace (GET /tracez).
+
+    Without TRACE_ID, lists retained traces (tail-sampled: errors and
+    the slowest requests are always kept). With a TRACE_ID — the value
+    of a response's X-Request-Id header — prints its span timeline."""
+    url = url.rstrip("/")
+    if not trace_id:
+        _echo_trace_list(url, n_traces, sort)
+        return
+    t = _http_json(f"{url}/tracez?id={trace_id}")
+    click.echo(
+        f"trace {t['id']}  status={t.get('status', '?')}  "
+        f"{t.get('dur_ms', 0):.2f} ms"
+        + (f"  error={t['error']}" if t.get("error") else "")
+    )
+    for k, v in (t.get("attrs") or {}).items():
+        click.echo(f"  {k}={v}")
+    for s in t.get("spans", []):
+        attrs = " ".join(
+            f"{k}={v}" for k, v in (s.get("attrs") or {}).items()
+        )
+        click.echo(
+            f"  {s.get('start_s', 0) * 1e3:9.3f} ms  "
+            f"{s.get('name', '?'):<14} "
+            f"{s.get('dur_s', 0) * 1e3:9.3f} ms  {attrs}"
+        )
 
 
 class _RunRefGroup(click.Group):
@@ -661,10 +784,13 @@ def agent_drain(queues):
               help="int8 weight-only quantize the projection kernels at "
                    "load (per-output-channel scales; prefill/embed/lm_head "
                    "stay full precision)")
+@click.option("--no-trace", is_flag=True,
+              help="disable per-request tracing (/tracez and X-Request-Id "
+                   "correlation stay, but no span timelines are recorded)")
 def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
           expected_devices, kv_pool_pages, kv_page_tokens, no_prefix_cache,
-          no_stream, speculate, draft_tokens, quantize):
+          no_stream, speculate, draft_tokens, quantize, no_trace):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
@@ -703,6 +829,8 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         overrides["speculate"] = True
     if quantize:
         overrides["quantize"] = True
+    if no_trace:
+        overrides["trace"] = False
     for field, value in (
         ("max_batch", max_batch),
         ("max_wait_ms", max_wait_ms),
@@ -738,7 +866,8 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
     click.echo(
         f"serving {server.model_name} (step {server.step}) "
         f"on http://{host}:{bound} [{mode}] — "
-        "POST /generate, GET /healthz, GET /readyz, GET /statsz"
+        "POST /generate, GET /healthz, GET /readyz, GET /statsz, "
+        "GET /tracez, GET /sloz"
     )
     import signal
     import threading

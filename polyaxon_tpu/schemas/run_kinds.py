@@ -254,6 +254,83 @@ class V1ServingSpec(BaseSchema):
         )
 
 
+class V1SLOSpec(BaseSchema):
+    """One service-level objective evaluated by the serving SLO engine
+    (telemetry/slo.py) as multi-window burn rates. `availability` SLOs
+    count 5xx responses against all requests; `latency` SLOs count
+    requests slower than `thresholdMs` against all requests."""
+
+    name: str
+    kind: Literal["availability", "latency"] = "availability"
+    # target success ratio in (0, 1), e.g. 0.999 = "three nines"
+    objective: float | str = 0.999
+    # latency kind only: the good/bad split point
+    threshold_ms: Optional[float | str] = None
+    # burn-rate evaluation windows, seconds, ascending; None = (60, 300)
+    windows: Optional[list[float]] = None
+    # breach when EVERY window burns >= this multiple of budget
+    burn_threshold: float | str = 1.0
+
+    @model_validator(mode="after")
+    def _check(self):
+        if isinstance(self.objective, (int, float)) and not (
+            0.0 < self.objective < 1.0
+        ):
+            raise ValueError(
+                f"slo {self.name!r}: objective must be in (0, 1), "
+                f"got {self.objective}"
+            )
+        if self.kind == "latency":
+            if self.threshold_ms is None:
+                raise ValueError(
+                    f"slo {self.name!r}: latency kind requires thresholdMs"
+                )
+            if (
+                isinstance(self.threshold_ms, (int, float))
+                and self.threshold_ms <= 0
+            ):
+                raise ValueError(
+                    f"slo {self.name!r}: thresholdMs must be > 0, "
+                    f"got {self.threshold_ms}"
+                )
+        elif self.threshold_ms is not None:
+            raise ValueError(
+                f"slo {self.name!r}: thresholdMs only applies to "
+                "kind=latency"
+            )
+        w = self.windows
+        if w is not None and (
+            not w or any(x <= 0 for x in w) or sorted(set(w)) != list(w)
+        ):
+            raise ValueError(
+                f"slo {self.name!r}: windows must be a strictly ascending "
+                f"list of positive seconds, got {w}"
+            )
+        if (
+            isinstance(self.burn_threshold, (int, float))
+            and self.burn_threshold <= 0
+        ):
+            raise ValueError(
+                f"slo {self.name!r}: burnThreshold must be > 0, "
+                f"got {self.burn_threshold}"
+            )
+        return self
+
+    def to_config(self) -> dict:
+        """The normalized dict telemetry.slo.build_objectives consumes."""
+        out = {
+            "name": self.name,
+            "kind": self.kind,
+            "objective": float(self.objective),
+            "burn_threshold": float(self.burn_threshold),
+        }
+        if self.windows is not None:
+            out["windows"] = [float(w) for w in self.windows]
+        if self.threshold_ms is not None:
+            out["threshold_ms"] = float(self.threshold_ms)
+        return out
+
+
 class V1ObservabilitySpec(BaseSchema):
     """Telemetry knobs (polyaxon_tpu/telemetry/) a run can pin in its
     spec. Presence of the section also opts the run into host/HBM
@@ -267,6 +344,9 @@ class V1ObservabilitySpec(BaseSchema):
     # span tracing on/off: the per-step data_wait/compute span tree
     # exported to <artifacts>/telemetry/spans.jsonl
     trace: bool = True
+    # serving SLOs: enables the burn-rate engine + breach flight recorder
+    # when this run's checkpoint is served (serving/server.py from_run)
+    slos: Optional[list[V1SLOSpec]] = None
 
     @model_validator(mode="after")
     def _check(self):

@@ -56,6 +56,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..chaos.injector import inject
+from ..telemetry import now as _metrics_now
 
 
 # ------------------------------------------------------------------ errors
@@ -86,8 +87,10 @@ class ServerClosingError(ShedError):
     """Terminal: the server is draining or shutting down. Queued requests
     failed with this will never be retried here — go elsewhere."""
 
-    def __init__(self, message: str = "server shutting down"):
-        super().__init__(message, reason="closing", retry_after_s=1.0)
+    def __init__(
+        self, message: str = "server shutting down", *, reason: str = "closing"
+    ):
+        super().__init__(message, reason=reason, retry_after_s=1.0)
 
 
 class DeadlineExceededError(ServingError):
@@ -190,6 +193,10 @@ class ServingConfig:
     speculate: bool = False
     draft_tokens: int = 4
     quantize: bool = False
+    # per-request tracing (ISSUE 9): build RequestTrace span trees and
+    # retain them in the server's tail-sampling TraceRing (/tracez)
+    trace: bool = True
+    trace_ring: int = 256  # recent-window capacity of the ring
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -240,6 +247,15 @@ class PendingRequest:
     on_finish: Optional[object] = None  # callable(req) on ANY terminal path
     t0: Optional[float] = None  # telemetry clock at admission (TTFT anchor)
     first_token_at: Optional[float] = None
+    # per-request tracing (ISSUE 9): the HTTP request's identity and its
+    # RequestTrace, shared by every row the body fanned into; `row`
+    # disambiguates spans, `submitted_t`/`finished_t` (telemetry clock)
+    # bound the queue_wait and stream_flush spans
+    request_id: Optional[str] = None
+    trace: Optional[object] = None  # telemetry.tracing.RequestTrace
+    row: int = 0
+    submitted_t: Optional[float] = None
+    finished_t: Optional[float] = None
 
     def finish(self, result=None, error=None):
         # idempotent: losing racers (deadline sweep vs decode completion)
@@ -248,6 +264,7 @@ class PendingRequest:
             return
         self.result = result
         self.error = error
+        self.finished_t = _metrics_now()  # stream_flush span anchor
         if self.on_finish is not None:
             try:
                 self.on_finish(self)
@@ -453,7 +470,9 @@ class DecodeCoalescer:
         if self._stop.is_set():
             raise ServerClosingError("coalescer is stopped: shutting down")
         if self._draining.is_set():
-            raise ServerClosingError("server draining: admission closed")
+            raise ServerClosingError(
+                "server draining: admission closed", reason="draining"
+            )
         if req.expired():
             self._shed(
                 "deadline", "request deadline already expired at admission"

@@ -143,10 +143,16 @@ class KVCacheManager:
         prompt_ladder: tuple,
         new_ladder: tuple,
         seq_len: int,
+        trace=None,
     ) -> RowPlan:
         """Admit one row: prefix lookup + suffix bucketing + reservation.
         Raises ServingError (400) when the row can NEVER fit the pool and
-        ShedError(reason="kv_pages") (503) when it cannot fit NOW."""
+        ShedError(reason="kv_pages") (503) when it cannot fit NOW.
+
+        `trace` (telemetry.tracing.RequestTrace) receives a zero-duration
+        `kv_plan` annotation with the admission decision — this module
+        stays clock-free (lint rule 4), the clock read happens inside
+        telemetry."""
         from .batching import choose_buckets
 
         pt = self.layout.page_tokens
@@ -199,6 +205,16 @@ class KVCacheManager:
             self.active_rows += 1
             self.active_rows_hwm = max(self.active_rows_hwm, self.active_rows)
             self._pages_changed()
+            if trace is not None:
+                trace.annotate(
+                    "kv_plan",
+                    prefix_len=L,
+                    prefix_hit=entry is not None,
+                    suffix_bucket=pb,
+                    new_bucket=nb,
+                    pages=n_pages,
+                    reserved=demand,
+                )
             return RowPlan(
                 prefix_len=L,
                 prefix_pages=tuple(ppages),
@@ -228,13 +244,15 @@ class KVCacheManager:
             self._pages_changed()
 
     # ------------------------------------------------------ decode support
-    def ensure_pages(self, plans, upto_slot: int) -> None:
+    def ensure_pages(self, plans, upto_slot: int, traces=None) -> None:
         """Allocate each plan's own pages to cover slots [0, upto_slot)
         out of its reservation. Called by the decode worker before
-        prefill / each chunk — cannot fail (reserved <= free invariant)."""
+        prefill / each chunk — cannot fail (reserved <= free invariant).
+        `traces` (parallel to `plans`) gets a `kv_ensure` annotation per
+        row that actually allocated."""
         pt = self.layout.page_tokens
         with self._lock:
-            for plan in plans:
+            for i, plan in enumerate(plans):
                 if plan is None:
                     continue
                 need_total = min(self.layout.pages_for(upto_slot), plan.n_pages)
@@ -244,6 +262,10 @@ class KVCacheManager:
                 ids = self.pool.alloc(need, reserved=True)
                 plan.reserved -= need
                 plan.own_pages.extend(ids)
+                if traces is not None and traces[i] is not None:
+                    traces[i].annotate(
+                        "kv_ensure", pages=need, upto_slot=upto_slot
+                    )
             self._pages_changed()
 
     def tables(self, plans, batch: int, n_pages: int):
@@ -296,9 +318,10 @@ class KVCacheManager:
 
     def harvest(self, rows) -> int:
         """Index each completed row's page-aligned prompt prefix. `rows`
-        is [(tokens, plan, pad)] — called by the decode worker AFTER the
-        group's tokens are out (harvest must not delay TTFT). Returns the
-        number of entries inserted."""
+        is [(tokens, plan, pad)] or [(tokens, plan, pad, trace)] —
+        called by the decode worker AFTER the group's tokens are out
+        (harvest must not delay TTFT). Returns the number of entries
+        inserted."""
         if self.prefix is None:
             return 0
         import jax.numpy as jnp
@@ -306,7 +329,9 @@ class KVCacheManager:
 
         pt = self.layout.page_tokens
         inserted = 0
-        for tokens, plan, pad in rows:
+        for row in rows:
+            tokens, plan, pad = row[:3]
+            trace = row[3] if len(row) > 3 else None
             if plan is None or plan.released:
                 continue
             k = len(tokens) // pt  # full prompt pages
@@ -342,6 +367,8 @@ class KVCacheManager:
                 # drop the allocation refs — the entries hold their own
                 self.pool.unref(new_ids)
                 self._pages_changed()
+            if trace is not None:
+                trace.annotate("kv_harvest_row", pages=n_new)
         return inserted
 
     # ---------------------------------------------------------------- stats

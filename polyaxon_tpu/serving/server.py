@@ -73,7 +73,16 @@ from typing import Optional
 
 from ..chaos.injector import inject
 from ..store.local import RunStore
-from ..telemetry import MetricsRegistry, now as _now
+from ..telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    RequestTrace,
+    SLOEngine,
+    TraceRing,
+    build_objectives,
+    new_trace_id,
+    now as _now,
+)
 from .batching import (
     CircuitBreaker,
     DeadlineExceededError,
@@ -88,6 +97,36 @@ from .batching import (
     choose_buckets,
 )
 from .kv import KVCacheManager
+
+
+def _trace_status(error: Optional[BaseException]) -> str:
+    """Trace status string for the tail sampler: everything that is not
+    a clean completion is retained preferentially."""
+    if error is None:
+        return "ok"
+    if isinstance(error, ShedError):
+        return f"shed:{error.reason}"
+    if isinstance(error, DeadlineExceededError):
+        return "deadline_exceeded"
+    if isinstance(error, ServingError):
+        return "invalid_request"
+    if isinstance(error, TimeoutError):
+        return "timeout"
+    return "error"
+
+
+def _error_reason(error: BaseException) -> str:
+    """The structured `reason` field every error body carries (satellite:
+    consistent across all shed reasons AND the 400/500/504 classes)."""
+    if isinstance(error, ShedError):
+        return error.reason
+    if isinstance(error, DeadlineExceededError):
+        return "deadline_exceeded"
+    if isinstance(error, ServingError):
+        return "invalid_request"
+    if isinstance(error, TimeoutError):
+        return "timeout"
+    return "internal"
 
 
 def _restore_params_subtree(ckpt_dir: str, abstract_params):
@@ -146,6 +185,9 @@ class ModelServer:
         config: Optional[ServingConfig] = None,
         registry: Optional[MetricsRegistry] = None,
         expected_devices: Optional[int] = None,
+        slos: Optional[list] = None,
+        debug_dir: Optional[str] = None,
+        slo_profile_s: float = 0.0,
     ):
         self.config = config or ServingConfig()
         # int8 quantize-on-load (ISSUE 8): rebuild the module with the
@@ -274,6 +316,53 @@ class ModelServer:
             help="Time to first token, milliseconds (admission → first "
             "sampled token; whole-decode on the dense path)",
         )
+        # per-request tracing (ISSUE 9): HTTP-level availability counters
+        # (request attempts and 5xx-class failures — the SLO engine's
+        # availability numerator/denominator), the tail-sampling trace
+        # ring behind /tracez, and a per-process decode-group id sequence
+        # so the B member rows of one coalesced batch share a group span
+        self._m_http = self.telemetry.counter(
+            "serving.http_requests",
+            help="HTTP /generate attempts (any outcome)",
+        )
+        self._m_http_err = self.telemetry.counter(
+            "serving.http_errors",
+            help="HTTP /generate 5xx-class failures (500/503/504)",
+        )
+        self.traces = TraceRing(capacity=int(self.config.trace_ring))
+        import itertools
+
+        self._group_seq = itertools.count(1)
+        # SLO engine + flight recorder (ISSUE 9): objectives come from
+        # observability.slos in the run spec (from_run) or the `slos`
+        # ctor arg (dicts shaped like V1SLOSpec.to_config()); a breach
+        # edge dumps a post-mortem bundle under <debug_dir>/
+        self.slo_engine: Optional[SLOEngine] = None
+        self.flight_recorder: Optional[FlightRecorder] = None
+        if slos:
+            if debug_dir is not None:
+                self.flight_recorder = FlightRecorder(
+                    debug_dir,
+                    registry=self.telemetry,
+                    trace_ring=self.traces,
+                    state_fn=self._occupancy_state,
+                    trace_fn=self._breach_trace,
+                    profile_s=slo_profile_s,
+                )
+            self.slo_engine = SLOEngine(
+                build_objectives(
+                    slos,
+                    bad=[self._m_http_err],
+                    total=[self._m_http],
+                    histogram=self._m_latency,
+                ),
+                self.telemetry,
+                on_breach=(
+                    self.flight_recorder.dump
+                    if self.flight_recorder is not None
+                    else None
+                ),
+            )
         self._prompt_ladder, self._new_ladder = self.config.ladders(
             int(module.cfg.seq_len)
         )
@@ -362,6 +451,70 @@ class ModelServer:
             ).inc()
         elif event == "shed":
             self._observe("shed", **ctx)
+
+    # ------------------------------------------------------------ tracing
+    def _new_trace(self, rid: str, **attrs) -> Optional[RequestTrace]:
+        """A RequestTrace for this request id, or None when tracing is
+        off (config.trace=False — the benchmarked fast-path toggle)."""
+        if not self.config.trace:
+            return None
+        return RequestTrace(rid, **attrs)
+
+    def _finish_trace(
+        self, trace: Optional[RequestTrace], error: Optional[BaseException]
+    ) -> None:
+        """Close the root span and hand the trace to the tail sampler."""
+        if trace is None:
+            return
+        trace.finish(
+            status=_trace_status(error),
+            error=None if error is None else str(error),
+        )
+        self.traces.record(trace)
+
+    def _trace_group(self, batch) -> tuple[int, float]:
+        """Open one decode group: a fresh group span id shared by every
+        member row's trace, plus each row's queue_wait span (submit →
+        dispatch on the telemetry clock). Returns (group_id, dispatch_t)
+        so the execute path can anchor its prefill/decode spans."""
+        gid = next(self._group_seq)
+        td = _now()
+        for r in batch:
+            if r.trace is None:
+                continue
+            r.trace.set_group(gid)
+            start = r.submitted_t if r.submitted_t is not None else r.trace.t0
+            r.trace.add(
+                "queue_wait",
+                start=start,
+                dur_s=td - start,
+                group=gid,
+                row=r.row,
+            )
+        return gid, td
+
+    def _occupancy_state(self) -> dict:
+        """Queue/KV occupancy snapshot for the flight-recorder bundle."""
+        out: dict = {"draining": self._draining}
+        c = self._coalescer
+        if c is not None:
+            out["queue"] = {
+                "depth": c.depth,
+                "breaker": c.breaker.state if c.breaker else None,
+            }
+        if self._kv is not None:
+            out["kv"] = self._kv.stats()
+        return out
+
+    def _breach_trace(self, breach: dict) -> Optional[dict]:
+        """The trace that explains a breach: for latency objectives the
+        p99 exemplar (the histogram observation that carried a trace id
+        near the spike); availability falls back to the ring's errors."""
+        if breach.get("kind") == "latency":
+            ex = self._m_latency.exemplar(0.99)
+            if ex is not None:
+                return self.traces.get(ex["trace_id"])
+        return None
 
     @property
     def compile_count(self) -> int:
@@ -568,6 +721,12 @@ class ModelServer:
             p_shard,
         )
         params, step = _restore_params_subtree(str(ckpt_dir), abstract)
+        # the run's own SLOs (spec observability.slos) arm the burn-rate
+        # engine; breach bundles land next to the checkpoints it serves
+        slos = None
+        obs = program.observability
+        if obs is not None and obs.slos:
+            slos = [s.to_config() for s in obs.slos]
         return cls(
             bundle.module,
             params,
@@ -575,6 +734,10 @@ class ModelServer:
             step=step,
             config=config,
             expected_devices=expected_devices,
+            slos=slos,
+            debug_dir=(
+                str(store.outputs_dir(uuid) / "debug") if slos else None
+            ),
         )
 
     # --------------------------------------------------------- validation
@@ -671,6 +834,7 @@ class ModelServer:
                         self._prompt_ladder,
                         self._new_ladder,
                         int(cfg.seq_len),
+                        trace=req.get("trace"),
                     )
                     pb, nb = plan.suffix_bucket, plan.new_bucket
                     key = GroupKey(
@@ -707,6 +871,9 @@ class ModelServer:
                     deadline=req["deadline"],
                     kv_plan=plan,
                     t0=_now(),
+                    request_id=req.get("rid"),
+                    trace=req.get("trace"),
+                    row=i,
                 )
                 if plan is not None:
                     # on ANY terminal path (scatter, shed, deadline, crash,
@@ -748,6 +915,7 @@ class ModelServer:
             self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
+        gid, td = self._trace_group(batch)
         P, N = key.prompt_bucket, key.new_bucket
         bb = batch_bucket(n, max(n, self.config.max_batch))
         arr = np.zeros((bb, P), np.int32)
@@ -781,6 +949,19 @@ class ModelServer:
             r.finish(
                 result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
             )
+            if r.trace is not None:
+                # dense path: one fused prefill+decode program, so the
+                # whole dispatch is one decode span
+                end = r.finished_t if r.finished_t is not None else _now()
+                r.trace.add(
+                    "decode",
+                    start=td,
+                    dur_s=end - td,
+                    group=gid,
+                    rows=n,
+                    steps=N,
+                    row=r.row,
+                )
         self._m_requests.inc(n)
 
     # ------------------------------------------------- speculative decode
@@ -857,6 +1038,7 @@ class ModelServer:
             self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
+        gid, td = self._trace_group(batch)
         P, N = key.prompt_bucket, key.new_bucket
         bb = batch_bucket(n, max(n, self.config.max_batch))
         arr = np.zeros((bb, P), np.int32)
@@ -899,6 +1081,21 @@ class ModelServer:
             r.finish(
                 result=out[i, pad : pad + r.prompt_len + r.max_new].tolist()
             )
+            if r.trace is not None:
+                # spec_generate fuses prefill + all verify windows; the
+                # span carries the group's accept accounting as attrs
+                end = r.finished_t if r.finished_t is not None else _now()
+                r.trace.add(
+                    "decode",
+                    start=td,
+                    dur_s=end - td,
+                    group=gid,
+                    rows=n,
+                    row=r.row,
+                    proposed=int(stats.get("proposed", 0)),
+                    accepted=int(stats.get("accepted", 0)),
+                    rollback=int(stats.get("rollback", 0)),
+                )
         self._m_requests.inc(n)
 
     def _execute_group_paged_spec(self, batch: list[PendingRequest]):
@@ -926,10 +1123,12 @@ class ModelServer:
             self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
+        gid, td = self._trace_group(batch)
         L, pb, nb = key.prefix_len, key.prompt_bucket, key.new_bucket
         n_pages = kv.layout.pages_for(L + pb + nb - 1)
         bb = batch_bucket(n, max(n, self.config.max_batch))
         plans = [r.kv_plan for r in batch] + [None] * (bb - n)
+        traces = [r.trace for r in batch]
         arr = np.zeros((bb, pb), np.int32)
         pads = np.full((bb,), pb - 1, np.int32)
         seeds = np.zeros((bb,), np.int32)
@@ -938,7 +1137,7 @@ class ModelServer:
             arr[i, pb - len(sfx):] = sfx
             pads[i] = pb - len(sfx)
             seeds[i] = r.seed
-        kv.ensure_pages(plans[:n], upto_slot=L + pb)
+        kv.ensure_pages(plans[:n], upto_slot=L + pb, traces=traces)
         tables = kv.tables(plans, bb, n_pages)
         with self._lock:
             fn = self._paged_prefill_fn(
@@ -959,6 +1158,11 @@ class ModelServer:
             r.first_token_at = tnow
             if r.t0 is not None:
                 self._m_ttft.observe((tnow - r.t0) * 1e3)
+            if r.trace is not None:
+                r.trace.add(
+                    "prefill", start=td, dur_s=tnow - td, group=gid,
+                    row=r.row, prefix_len=L, suffix_bucket=pb,
+                )
             if r.on_tokens is not None:
                 try:
                     r.on_tokens([int(first_np[i])])
@@ -994,6 +1198,7 @@ class ModelServer:
                 emit(i, [int(key.eos_id)] * int(remaining[i]))
                 remaining[i] = 0
         totals = {"proposed": 0, "accepted": 0, "rollback": 0}
+        t_prev, window = _now(), 0
         while (remaining > 0).any():
             fed = np.empty((bb, K + 1), np.int32)
             fed[:, 0] = tok
@@ -1027,6 +1232,20 @@ class ModelServer:
             )
             for k in totals:
                 totals[k] += delta[k]
+            t_new = _now()
+            for r in batch:
+                if r.trace is not None:
+                    # one verify-window span per window, with the window's
+                    # accept accounting — the per-window decode/verify
+                    # children the trace invariant sums
+                    r.trace.add(
+                        "verify", start=t_prev, dur_s=t_new - t_prev,
+                        group=gid, row=r.row, window=window,
+                        proposed=delta["proposed"],
+                        accepted=delta["accepted"],
+                        rollback=delta["rollback"],
+                    )
+            t_prev, window = t_new, window + 1
             for i in range(n):
                 toks = committed[i]
                 if not len(toks):
@@ -1040,17 +1259,24 @@ class ModelServer:
                     emit(i, [int(key.eos_id)] * int(remaining[i]))
                     remaining[i] = 0
         self._spec_observe(totals)
+        th0 = _now()
         try:
             with self._lock:  # harvest donates the pool buffer too
                 kv.harvest(
                     [
-                        (r.tokens, r.kv_plan, int(pads[i]))
+                        (r.tokens, r.kv_plan, int(pads[i]), r.trace)
                         for i, r in enumerate(batch)
                     ]
                 )
         except Exception:  # noqa: BLE001 — cache warmth must not fail rows
             pass
+        th1 = _now()
         for i, r in enumerate(batch):
+            if r.trace is not None:
+                r.trace.add(
+                    "kv_harvest", start=th0, dur_s=th1 - th0, group=gid,
+                    row=r.row,
+                )
             r.finish(result=list(r.tokens) + gen[i][: r.max_new])
         self._m_requests.inc(n)
 
@@ -1116,11 +1342,13 @@ class ModelServer:
             self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
+        gid, td = self._trace_group(batch)
         L, pb, nb = key.prefix_len, key.prompt_bucket, key.new_bucket
         pt = kv.layout.page_tokens
         n_pages = kv.layout.pages_for(L + pb + nb - 1)
         bb = batch_bucket(n, max(n, self.config.max_batch))
         plans = [r.kv_plan for r in batch] + [None] * (bb - n)
+        traces = [r.trace for r in batch]
         arr = np.zeros((bb, pb), np.int32)
         pads = np.full((bb,), pb - 1, np.int32)  # dummy rows: length-1 suffix
         seeds = np.zeros((bb,), np.int32)
@@ -1130,7 +1358,7 @@ class ModelServer:
             pads[i] = pb - len(sfx)
             seeds[i] = r.seed
         # prefill: writes suffix KV into slots [L, L+pb) of each row's pages
-        kv.ensure_pages(plans[:n], upto_slot=L + pb)
+        kv.ensure_pages(plans[:n], upto_slot=L + pb, traces=traces)
         tables = kv.tables(plans, bb, n_pages)
         with self._lock:
             fn = self._paged_prefill_fn(
@@ -1151,6 +1379,11 @@ class ModelServer:
             r.first_token_at = tnow
             if r.t0 is not None:
                 self._m_ttft.observe((tnow - r.t0) * 1e3)
+            if r.trace is not None:
+                r.trace.add(
+                    "prefill", start=td, dur_s=tnow - td, group=gid,
+                    row=r.row, prefix_len=L, suffix_bucket=pb,
+                )
             if r.on_tokens is not None:
                 try:
                     r.on_tokens([int(first_np[i])])
@@ -1162,9 +1395,10 @@ class ModelServer:
         pos, g, remaining = L + pb, 1, nb - 1
         chunk_cap = max(1, int(self.config.stream_chunk_tokens))
         early_eos = False
+        t_prev, window = tnow, 0
         while remaining > 0:
             steps = min(chunk_cap, remaining)
-            kv.ensure_pages(plans[:n], upto_slot=pos + steps)
+            kv.ensure_pages(plans[:n], upto_slot=pos + steps, traces=traces)
             tables = kv.tables(plans, bb, n_pages)
             with self._lock:
                 fn = self._paged_chunk_fn(
@@ -1193,6 +1427,17 @@ class ModelServer:
                     except Exception:  # noqa: BLE001
                         pass
             tok = toks[:, -1]
+            t_new = _now()
+            for r in batch:
+                if r.trace is not None:
+                    # contiguous per-window decode spans: each starts where
+                    # the previous ended, so the children partition the
+                    # decode region exactly (the /tracez sum invariant)
+                    r.trace.add(
+                        "decode", start=t_prev, dur_s=t_new - t_prev,
+                        group=gid, row=r.row, window=window, steps=steps,
+                    )
+            t_prev, window = t_new, window + 1
             pos += steps
             g += steps
             remaining -= steps
@@ -1215,17 +1460,24 @@ class ModelServer:
         # index each row's page-aligned prompt prefix BEFORE finish()
         # releases the pages — the next request with this prompt prefix
         # skips its prefill
+        th0 = _now()
         try:
             with self._lock:  # harvest donates the pool buffer too
                 kv.harvest(
                     [
-                        (r.tokens, r.kv_plan, int(pads[i]))
+                        (r.tokens, r.kv_plan, int(pads[i]), r.trace)
                         for i, r in enumerate(batch)
                     ]
                 )
         except Exception:  # noqa: BLE001 — cache warmth must not fail rows
             pass
+        th1 = _now()
         for i, r in enumerate(batch):
+            if r.trace is not None:
+                r.trace.add(
+                    "kv_harvest", start=th0, dur_s=th1 - th0, group=gid,
+                    row=r.row,
+                )
             r.finish(result=list(r.tokens) + gen[i][: r.max_new])
         self._m_requests.inc(n)
 
@@ -1239,6 +1491,7 @@ class ModelServer:
         arr = np.stack([np.asarray(r.tokens, np.int32) for r in batch])
         self._m_occupancy.observe(len(batch))
         self._m_batches.inc()
+        gid, td = self._trace_group(batch)
         with self._lock:
             fn = self._decode_fn(
                 arr.shape[0], arr.shape[1], key.new_bucket,
@@ -1250,6 +1503,16 @@ class ModelServer:
             )
         for i, r in enumerate(batch):
             r.finish(result=out[i].tolist())
+            if r.trace is not None:
+                r.trace.add(
+                    "decode",
+                    start=td,
+                    dur_s=(r.finished_t or _now()) - td,
+                    group=gid,
+                    rows=len(batch),
+                    row=r.row,
+                    num_beams=key.num_beams,
+                )
         self._m_requests.inc(len(batch))
 
     def _dispatch_group(self, batch: list[PendingRequest]):
@@ -1302,22 +1565,40 @@ class ModelServer:
             self._dispatch_group(group)
         return {"tokens": [r.result for r in rows]}
 
-    def handle_request(self, body: dict) -> dict:
+    def handle_request(
+        self, body: dict, request_id: Optional[str] = None
+    ) -> dict:
         """HTTP-path entry: producer side of the coalescer. Falls back to
         the synchronous path for beams and when batching is off. End-to-end
         latency (validate → all rows scattered back) lands in the
-        request-seconds histogram either way."""
+        request-seconds histogram either way, carrying the request id as
+        its exemplar; the per-request trace lands in the tail sampler."""
+        rid = request_id or new_trace_id()
+        trace = self._new_trace(rid)
         t0 = _now()
+        error: Optional[BaseException] = None
         try:
-            return self._handle_request(body)
+            return self._handle_request(body, rid=rid, trace=trace)
+        except BaseException as e:
+            error = e
+            raise
         finally:
-            self._m_latency.observe(_now() - t0)
+            self._m_latency.observe(_now() - t0, exemplar=rid)
+            self._finish_trace(trace, error)
 
-    def _handle_request(self, body: dict) -> dict:
+    def _handle_request(
+        self,
+        body: dict,
+        rid: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
+    ) -> dict:
         if self._draining:
             self._observe("shed", reason="draining")
-            raise ServerClosingError("server draining: admission closed")
+            raise ServerClosingError(
+                "server draining: admission closed", reason="draining"
+            )
         req = self._validate(body)
+        req["rid"], req["trace"] = rid, trace
         if (
             self._coalescer is None
             or self._coalescer._thread is None
@@ -1331,11 +1612,18 @@ class ModelServer:
                     "deadline already expired at admission",
                     reason="deadline",
                 )
+            if trace is not None:
+                t_sync = _now()
+                trace.add("admission", start=trace.t0, dur_s=t_sync - trace.t0)
+                out = self.generate(body)
+                trace.add("decode", start=t_sync, dur_s=_now() - t_sync)
+                return out
             return self.generate(body)
         rows = self._make_requests(req)
         submitted = []
         try:
             for r in rows:
+                r.submitted_t = _now()
                 self._coalescer.submit(r)
                 submitted.append(r)
         except ShedError:
@@ -1350,6 +1638,12 @@ class ModelServer:
             for r in submitted:
                 r.done.wait(self.config.request_timeout_s)
             raise
+        if trace is not None:
+            # validate + kv plan + submit, measured from the root start to
+            # the first row entering the queue — the piece of latency the
+            # queue_wait/decode spans don't cover
+            first = rows[0].submitted_t if rows else trace.t0
+            trace.add("admission", start=trace.t0, dur_s=first - trace.t0)
         timeout = self.config.request_timeout_s
         for r in rows:
             if not r.done.wait(timeout):
@@ -1358,10 +1652,18 @@ class ModelServer:
                 )
             if r.error is not None:
                 raise r.error
-        return {"tokens": [r.result for r in rows]}
+        out = {"tokens": [r.result for r in rows]}
+        if trace is not None:
+            # scatter-back: last row finishing → response body assembled
+            done_t = max(
+                (r.finished_t for r in rows if r.finished_t is not None),
+                default=_now(),
+            )
+            trace.add("stream_flush", start=done_t, dur_s=_now() - done_t)
+        return out
 
     # ----------------------------------------------------------- streaming
-    def stream_request(self, body: dict):
+    def stream_request(self, body: dict, request_id: Optional[str] = None):
         """Streaming producer path (`POST /generate?stream=1`): yields one
         event dict per decoded chunk as the paged decode emits it —
         `{"row": i, "tokens": [...]}` with newly generated tokens (the
@@ -1371,19 +1673,34 @@ class ModelServer:
         row, then `{"done": true}`. Admission errors (400/503/504) raise
         before the first event so the HTTP layer can still set a status
         code; later failures become in-band error events."""
+        rid = request_id or new_trace_id()
+        trace = self._new_trace(rid, stream=True)
         t0 = _now()
+        error: Optional[BaseException] = None
         try:
-            yield from self._stream_request(body)
+            yield from self._stream_request(body, rid=rid, trace=trace)
+        except BaseException as e:
+            error = e
+            raise
         finally:
-            self._m_latency.observe(_now() - t0)
+            self._m_latency.observe(_now() - t0, exemplar=rid)
+            self._finish_trace(trace, error)
 
-    def _stream_request(self, body: dict):
+    def _stream_request(
+        self,
+        body: dict,
+        rid: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
+    ):
         import queue as _queue
 
         if self._draining:
             self._observe("shed", reason="draining")
-            raise ServerClosingError("server draining: admission closed")
+            raise ServerClosingError(
+                "server draining: admission closed", reason="draining"
+            )
         req = self._validate(body)
+        req["rid"], req["trace"] = rid, trace
         if (
             self._kv is None
             or self._coalescer is None
@@ -1392,7 +1709,7 @@ class ModelServer:
         ):
             # no incremental decode on this path: degrade to one terminal
             # chunk per row (same event shape, no partial delivery)
-            out = self._handle_request(body)
+            out = self._handle_request(body, rid=rid, trace=trace)
             for i, row in enumerate(out["tokens"]):
                 yield {"row": i, "tokens": row[len(req["arr"][i]) :]}
                 yield {"row": i, "done": True}
@@ -1419,6 +1736,7 @@ class ModelServer:
         submitted = []
         try:
             for r in rows:
+                r.submitted_t = _now()
                 self._coalescer.submit(r)
                 submitted.append(r)
         except ShedError:
@@ -1428,6 +1746,9 @@ class ModelServer:
             for r in submitted:
                 r.done.wait(self.config.request_timeout_s)
             raise
+        if trace is not None:
+            first = rows[0].submitted_t if rows else trace.t0
+            trace.add("admission", start=trace.t0, dur_s=first - trace.t0)
         pending = len(rows)
         while pending:
             try:
@@ -1440,6 +1761,12 @@ class ModelServer:
             if "done" in ev or "error" in ev:
                 pending -= 1
             yield ev
+        if trace is not None:
+            done_t = max(
+                (r.finished_t for r in rows if r.finished_t is not None),
+                default=_now(),
+            )
+            trace.add("stream_flush", start=done_t, dur_s=_now() - done_t)
         yield {"done": True}
 
     # --------------------------------------------------------- readiness
@@ -1522,6 +1849,17 @@ class ModelServer:
             "enabled": bool(self.config.quantize),
             "bytes_saved": int(self._quant_bytes_saved),
         }
+        tracing = {
+            "enabled": bool(self.config.trace),
+            **self.traces.stats(),
+        }
+        slo = (
+            self.slo_engine.to_dict()
+            if self.slo_engine is not None
+            else {"enabled": False, "breached": False, "slos": []}
+        )
+        if self.flight_recorder is not None:
+            slo["flight_recorder_dumps"] = self.flight_recorder.dumps
         return {
             "kv": kv,
             "speculation": speculation,
@@ -1548,6 +1886,8 @@ class ModelServer:
             "max_new_buckets": list(self._new_ladder),
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
+            "tracing": tracing,
+            "slo": slo,
         }
 
     # ------------------------------------------------------------ http
@@ -1561,7 +1901,16 @@ class ModelServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, payload: dict, headers: dict = None):
+            def _send(
+                self,
+                code: int,
+                payload: dict,
+                headers: dict = None,
+                rid: str = None,
+            ):
+                if rid is not None:
+                    payload = {**payload, "requestId": rid}
+                    headers = {**(headers or {}), "X-Request-Id": rid}
                 self._send_raw(
                     code,
                     json.dumps(payload).encode(),
@@ -1581,7 +1930,8 @@ class ModelServer:
                 self.wfile.write(data)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
                     self._send(
                         200,
                         {
@@ -1590,40 +1940,76 @@ class ModelServer:
                             "step": server.step,
                         },
                     )
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     ready, reason = server.readiness()
                     self._send(
                         200 if ready else 503,
                         {"ready": ready, "reason": reason},
                     )
-                elif self.path == "/statsz":
+                elif path == "/statsz":
                     self._send(200, server.stats())
-                elif self.path == "/metricsz":
+                elif path == "/metricsz":
                     self._send_raw(
                         200,
                         server.telemetry.render_prometheus().encode(),
                         "text/plain; version=0.0.4",
                     )
+                elif path == "/tracez":
+                    self._tracez(query)
+                elif path == "/sloz":
+                    self._send(
+                        200,
+                        server.slo_engine.to_dict()
+                        if server.slo_engine is not None
+                        else {"enabled": False, "breached": False, "slos": []},
+                    )
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
-            def _stream(self, body):
+            def _tracez(self, query: str):
+                from urllib.parse import parse_qs
+
+                q = parse_qs(query)
+                tid = (q.get("id") or [None])[0]
+                if tid is not None:
+                    tr = server.traces.get(tid)
+                    if tr is None:
+                        self._send(404, {"error": f"no trace {tid!r}"})
+                    else:
+                        self._send(200, tr)
+                    return
+                try:
+                    n = int((q.get("n") or ["50"])[0])
+                    sort = (q.get("sort") or ["recent"])[0]
+                    traces = server.traces.list(n=n, sort=sort)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                self._send(
+                    200, {"traces": traces, **server.traces.stats()}
+                )
+
+            def _stream(self, body, rid):
                 """SSE response: one `data: <json>` frame per event from
                 stream_request(). The first event is pulled BEFORE headers
                 go out so admission failures still map to real status
                 codes; mid-stream failures become an in-band error frame
-                (the 200 is already on the wire)."""
-                gen = server.stream_request(body)
+                (the 200 is already on the wire). Every frame carries the
+                request id — SSE clients can't reread response headers
+                after a reconnect."""
+                gen = server.stream_request(body, request_id=rid)
                 first = next(gen)  # admission errors raise here
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-store")
                 self.send_header("Connection", "close")
+                self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 import itertools
 
                 try:
                     for ev in itertools.chain((first,), gen):
+                        ev = {**ev, "requestId": rid}
                         self.wfile.write(
                             b"data: " + json.dumps(ev).encode() + b"\n\n"
                         )
@@ -1636,7 +2022,9 @@ class ModelServer:
                     try:
                         self.wfile.write(
                             b"data: "
-                            + json.dumps({"error": str(e)}).encode()
+                            + json.dumps(
+                                {"error": str(e), "requestId": rid}
+                            ).encode()
                             + b"\n\n"
                         )
                     except OSError:
@@ -1647,16 +2035,28 @@ class ModelServer:
                 if path != "/generate":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
+                # accept-or-assign: the caller's id (bounded, for log
+                # correlation across services) or a fresh 16-hex one
+                rid = (
+                    (self.headers.get("X-Request-Id") or "").strip()[:128]
+                    or new_trace_id()
+                )
                 want_stream = "stream=1" in query.split("&")
+                server._m_http.inc()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
                     if want_stream and server.config.stream:
-                        self._stream(body)
+                        self._stream(body, rid)
                     else:
-                        self._send(200, server.handle_request(body))
+                        self._send(
+                            200,
+                            server.handle_request(body, request_id=rid),
+                            rid=rid,
+                        )
                 except ShedError as e:
                     # shed at admission: never queued, safe to retry later
+                    server._m_http_err.inc()
                     self._send(
                         503,
                         {"error": str(e), "reason": e.reason},
@@ -1665,21 +2065,46 @@ class ModelServer:
                                 max(1, int(round(e.retry_after_s)))
                             )
                         },
+                        rid=rid,
                     )
                 except DeadlineExceededError as e:
+                    server._m_http_err.inc()
                     self._send(
-                        504, {"error": str(e), "reason": "deadline_exceeded"}
+                        504,
+                        {"error": str(e), "reason": "deadline_exceeded"},
+                        rid=rid,
                     )
                 except ServingError as e:
-                    self._send(400, {"error": str(e)})
+                    # 400s are client errors: excluded from the
+                    # availability SLO's bad-event counter
+                    self._send(
+                        400,
+                        {"error": str(e), "reason": "invalid_request"},
+                        rid=rid,
+                    )
                 except TimeoutError as e:
-                    self._send(504, {"error": str(e), "reason": "timeout"})
+                    server._m_http_err.inc()
+                    self._send(
+                        504,
+                        {"error": str(e), "reason": "timeout"},
+                        rid=rid,
+                    )
                 except Exception as e:  # noqa: BLE001 — surface, don't kill
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    server._m_http_err.inc()
+                    self._send(
+                        500,
+                        {
+                            "error": f"{type(e).__name__}: {e}",
+                            "reason": "internal",
+                        },
+                        rid=rid,
+                    )
 
         self._httpd = _Httpd((host, port), Handler)
         self._draining = False
         self._m_ready.set(1)
+        if self.slo_engine is not None:
+            self.slo_engine.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -1702,6 +2127,8 @@ class ModelServer:
         )
         self._draining = True
         self._m_ready.set(0)
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self._coalescer is not None:
             self._coalescer.stop(drain_s=grace)
             # a restarted server gets a fresh worker (and breaker)

@@ -13,6 +13,13 @@ monitor wrote straight to the store. Everything now flows through:
 - `SpanTracer` — context-manager spans with parent/child nesting,
   exported as JSONL into the run's artifacts dir next to the
   jax.profiler trace.
+- `RequestTrace`/`TraceRing` (tracing.py) — the serving-side trace
+  builder: explicit-parent spans that survive thread hops, plus a
+  tail-sampling ring that always keeps errors/sheds/deadline-exceeded
+  and the slowest tail. `/tracez` reads the ring.
+- `SLOEngine`/`FlightRecorder` (slo.py) — multi-window burn rates over
+  registry counters/histograms, `slo_burn_rate`/`slo_breached` gauges,
+  and the breach-triggered post-mortem bundle under `<outputs>/debug/`.
 - `quantile`/`summarize` — the one exact-percentile implementation
   (benchmarks used to each carry their own).
 - `now()` — the sanctioned monotonic clock for metrics timing. No other
@@ -36,17 +43,33 @@ from .registry import (
     get_registry,
     now,
 )
+from .slo import (
+    AvailabilityObjective,
+    FlightRecorder,
+    LatencyObjective,
+    SLOEngine,
+    build_objectives,
+)
 from .spans import SpanTracer, get_tracer
 from .stats import mfu, quantile, summarize, train_step_flops
+from .tracing import RequestTrace, TraceRing, new_trace_id
 
 __all__ = [
+    "AvailabilityObjective",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "LatencyObjective",
     "MetricsRegistry",
+    "RequestTrace",
+    "SLOEngine",
     "SpanTracer",
+    "TraceRing",
+    "build_objectives",
     "get_registry",
     "get_tracer",
+    "new_trace_id",
     "mfu",
     "now",
     "quantile",

@@ -23,6 +23,12 @@ Export schema per line:
 
 Durations come from the monotonic metrics clock (registry.now); `ts` is
 wall-clock so lines are correlatable with logs and store events.
+
+Thread-local nesting is the right model ONLY for single-thread loops.
+A serving request hops threads (HTTP handler → coalescer queue → decode
+worker), so its trace is built with the explicit-parent
+`RequestTrace`/`TraceRing` companions in tracing.py (re-exported here)
+— same clock, no thread-local state, tail-sampled retention.
 """
 
 from __future__ import annotations
@@ -36,6 +42,15 @@ from pathlib import Path
 from typing import Optional
 
 from .registry import now
+from .tracing import RequestTrace, TraceRing, new_trace_id  # noqa: F401
+
+__all__ = [
+    "RequestTrace",
+    "SpanTracer",
+    "TraceRing",
+    "get_tracer",
+    "new_trace_id",
+]
 
 
 class _SpanHandle:

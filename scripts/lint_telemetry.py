@@ -53,6 +53,18 @@ duration of its own; its one observable (bytes saved) is a counter, not
 a latency. Any `time.time/monotonic/perf_counter` (and `_ns` variants)
 in either module is forbidden — logical generation index only.
 
+Seventh rule: the SLO/trace layer itself uses only the injected
+telemetry clock. `polyaxon_tpu/telemetry/slo.py` (burn-rate windows)
+and `polyaxon_tpu/telemetry/tracing.py` (request span timelines) are
+the modules whose OUTPUT the canary gates on; a raw `time.*()` read
+there would mix wall-clock (NTP steps, DST) into burn windows and span
+durations — the exact drift this lint exists to prevent. They must take
+time from `registry.now` (or an injected `clock=` callable), so any
+direct `time.time/monotonic/perf_counter` (and `_ns` variants) call in
+those two files is forbidden. The rest of `polyaxon_tpu/telemetry/`
+stays exempt (registry.py DEFINES the clock; spans.py stamps wall-clock
+`ts` for log correlation by design).
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -89,6 +101,13 @@ SPEC_MODULES = (
     ("polyaxon_tpu", "models", "spec_decode.py"),
     ("polyaxon_tpu", "models", "quant.py"),
 )
+SLO_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter)(?:_ns)?\s*\("
+)
+SLO_MODULES = (
+    ("polyaxon_tpu", "telemetry", "slo.py"),
+    ("polyaxon_tpu", "telemetry", "tracing.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -97,6 +116,20 @@ def violations(repo_root: Path) -> list[str]:
     for py in sorted(pkg.rglob("*.py")):
         rel = py.relative_to(repo_root)
         if rel.parts[:2] == ("polyaxon_tpu", "telemetry"):
+            # the telemetry package owns the clock — exempt from rules
+            # 1-6, but the SLO/trace modules must take time via
+            # registry.now / an injected clock, never directly
+            if rel.parts in SLO_MODULES:
+                for i, line in enumerate(
+                    py.read_text().splitlines(), 1
+                ):
+                    code = line.split("#", 1)[0]
+                    if SLO_PATTERN.search(code):
+                        out.append(
+                            f"{rel}:{i}: raw clock in the SLO/trace "
+                            f"layer — inject the telemetry clock "
+                            f"(registry.now): {line.strip()}"
+                        )
             continue
         in_scheduler = rel.parts[:2] == ("polyaxon_tpu", "scheduler")
         clock_exempt = in_scheduler and rel.name == "clock.py"

@@ -481,6 +481,121 @@ PY
       echo "ELASTIC-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # slo/trace gate: mixed traffic (good decodes + deterministic
+    # deadline sheds) through a server armed with a tight availability
+    # SLO, then require the burn-rate gauges on /metricsz, a COMPLETE
+    # trace on /tracez (nonzero queue_wait + decode spans — a timeline
+    # with dark gaps cannot explain a p99), and a flight-recorder
+    # bundle on the breach. Dark burn rates or hollow traces FAIL.
+    echo "running slo/trace metricsz smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import pathlib
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+debug_dir = tempfile.mkdtemp(prefix="slo-canary-")
+server = ModelServer(
+    b.module, params,
+    config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                         kv_pool_pages=64, kv_page_tokens=8),
+    slos=[{"name": "availability", "kind": "availability",
+           "objective": 0.999, "windows": [5.0, 30.0]}],
+    debug_dir=debug_dir,
+)
+port = server.start(port=0)
+try:
+    def post(body, rid=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"X-Request-Id": rid} if rid else {})},
+        )
+        try:
+            r = urllib.request.urlopen(req, timeout=300)
+            return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    good = {"tokens": [list(range(1, 9))], "maxNewTokens": 8,
+            "temperature": 0.8, "topK": 40, "seed": 0}
+    st, out, hdr = post(good, rid="canary-good")
+    if st != 200 or hdr.get("X-Request-Id") != "canary-good":
+        print("slo/trace smoke: good request lost its id", st, hdr)
+        sys.exit(1)
+    # deterministic 503s: an already-expired deadline sheds at admission
+    for i in range(4):
+        st, out, _ = post({**good, "deadlineMs": 1e-6, "seed": i + 1})
+        if st != 503 or out.get("reason") != "deadline" or not out.get("requestId"):
+            print("slo/trace smoke: shed shape wrong", st, out)
+            sys.exit(1)
+    server.slo_engine.evaluate()  # don't wait for the background cadence
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    trace = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/tracez?id=canary-good", timeout=30
+    ).read())
+    sloz = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/sloz", timeout=30
+    ).read())
+finally:
+    server.stop()
+with open("tpu_results/slo_trace_tpu.txt", "w") as f:
+    f.write(text)
+    f.write("\n--- tracez?id=canary-good ---\n")
+    f.write(json.dumps(trace, indent=1))
+    f.write("\n--- sloz ---\n")
+    f.write(json.dumps(sloz, indent=1))
+required = ("slo_burn_rate", "slo_breached",
+            "serving_http_requests_total", "serving_http_errors_total")
+missing = [s for s in required if s not in text]
+if missing:
+    print("slo/trace smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+spans = {s["name"]: s for s in trace.get("spans", [])}
+if "queue_wait" not in spans or "decode" not in spans:
+    print("slo/trace smoke: trace missing queue_wait/decode spans:",
+          sorted(spans))
+    sys.exit(1)
+if spans["queue_wait"]["dur_s"] <= 0 or spans["decode"]["dur_s"] <= 0:
+    print("slo/trace smoke: zero-duration queue_wait/decode spans", spans)
+    sys.exit(1)
+if not sloz.get("breached"):
+    print("slo/trace smoke: 4/5 sheds did not breach the 99.9% "
+          "availability SLO", sloz)
+    sys.exit(1)
+bundles = sorted(pathlib.Path(debug_dir).glob("slo-*/breach.json"))
+if not bundles:
+    print("slo/trace smoke: breach fired but no flight-recorder bundle "
+          f"under {debug_dir}")
+    sys.exit(1)
+print(f"slo/trace metricsz smoke: ok ({len(required)} required series "
+      f"present, trace has {len(spans)} span kinds, breach bundle at "
+      f"{bundles[0].parent})")
+PY
+    then
+      echo "SLO-TRACE-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

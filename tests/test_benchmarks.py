@@ -186,6 +186,22 @@ def test_serving_bench_speculate_schema(tmp_home):
     assert 0.0 <= q["top1_agreement_vs_fp"] <= 1.0
 
 
+def test_serving_bench_trace_overhead_schema(tmp_home):
+    proc = _run("benchmarks/serving_bench.py", "--smoke", "--trace-overhead")
+    # rc=1 is the script's own "tracing cost above 5%" gate — fail loudly
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    recs = _records(proc)
+    assert len(recs) == 1, recs
+    r = recs[0]
+    assert r["metric"] == "serving_trace_overhead" and r["unit"] == "%"
+    assert {
+        "value", "req_per_sec_on", "req_per_sec_off", "p99_on_ms",
+        "p99_off_ms", "repeats",
+    } <= r.keys(), r
+    assert r["req_per_sec_on"] > 0 and r["req_per_sec_off"] > 0
+    assert r["value"] <= 5.0, r
+
+
 def test_elastic_bench_schema(tmp_home):
     proc = _run("benchmarks/elastic_bench.py", "--smoke")
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])

@@ -524,7 +524,10 @@ def test_http_graceful_drain_readyz_and_inflight():
     code, body = _get(port, "/readyz")
     assert code == 503 and json.loads(body)["ready"] is False
     code, payload, _ = _post(port, BODY)
-    assert code == 503 and payload["reason"] == "closing"
+    # shed at admission mid-drain: "draining" (never queued, retryable
+    # elsewhere) — "closing" is reserved for queued requests failed
+    # terminally when the drain budget expires
+    assert code == 503 and payload["reason"] == "draining"
     release.set()  # let the in-flight request finish inside the budget
     bg.join(10)
     stopper.join(10)

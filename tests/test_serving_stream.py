@@ -142,7 +142,13 @@ def test_streamed_equals_non_streamed(servers):
             if "row" in ev and "tokens" in ev:
                 chunks[ev["row"]].extend(ev["tokens"])
     c.close()
-    assert events[-1] == {"done": True}
+    # every frame carries the request id (ISSUE 9) alongside the
+    # terminal done marker
+    done = events[-1]
+    assert done["done"] is True and done["requestId"]
+    assert all(
+        ev["requestId"] == done["requestId"] for ev in events
+    )
     assert not any("error" in ev for ev in events), events
     for i, p in enumerate(prompts):
         assert p + chunks[i] == full[i], (i, chunks[i], full[i])
@@ -227,7 +233,8 @@ def test_speculative_servers_byte_identical_over_http(servers):
                 if "row" in ev and "tokens" in ev:
                     chunks[ev["row"]].extend(ev["tokens"])
         c.close()
-        assert events[-1] == {"done": True}
+        done = events[-1]
+        assert done["done"] is True and done["requestId"]
         assert not any("error" in ev for ev in events), events
         for i, p in enumerate(prompts):
             assert p + chunks[i] == full[i], (i, chunks[i], full[i])
