@@ -26,6 +26,20 @@ The base config also reports the block-paged decode path (ISSUE 6):
 TPU the donated input buffer MUST be invalidated (hard assert); CPU
 ignores donation, so there it is report-only.
 
+With ≥2 visible devices the base config also reports the ISSUE 10
+tensor-parallel record — the same paged decode under the named 2-D
+serving mesh (`batch`×`model`, projection kernels sharded over `model`):
+
+  {"metric": "tp_decode_tokens_per_sec", "value": N, "unit": "tok/s",
+   "mesh": {"batch": b, "model": m}, "mesh_devices": d,
+   "per_token_ms": ..., "single_chip_per_token_ms": ...,
+   "per_token_speedup_vs_single_chip": ..., "cache_donated": true}
+
+On TPU the record hard-asserts that per-token latency beats the
+single-chip paged record at fixed batch and that donation survives
+sharding; on CPU fake devices (POLYAXON_NUM_CPU_DEVICES) it is
+report-only. Single-device hosts skip the record.
+
 The base config also reports the ISSUE 8 fast-decode paths:
 
   {"metric": "speculative_decode_tokens_per_sec", "value": N,
@@ -102,10 +116,13 @@ def sweep_configs(on_tpu: bool):
         yield cfg, batch, cache_len // 2, max_new, False
 
 
-def run_paged(bundle, params, cfg, batch, prompt_len, max_new, device, timed):
-    """Paged-decode record for the base config: TTFT (prefill + first
-    sample), steady-state tok/s through the page tables, and the donation
-    assertion (the prefill cache buffer must be consumed in place)."""
+def _paged_timing(bundle, params, cfg, batch, prompt_len, max_new, device):
+    """One paged-decode measurement: TTFT (prefill + first sample),
+    steady-state per-token latency through the page tables, and the
+    donation probe (the prefill cache buffer must be consumed in place —
+    hard-asserted on TPU, report-only on CPU). Shared by the single-chip
+    record and the tensor-parallel record, which differ only in the
+    params' sharding and the active mesh."""
     import time as _time
 
     import jax
@@ -192,20 +209,103 @@ def run_paged(bundle, params, cfg, batch, prompt_len, max_new, device, timed):
         2 * 2 * cfg["n_layers"] * layout.pool_pages * pt
         * cfg["n_kv_heads"] * head_dim
     )
-    print(json.dumps({
+    return {
+        "page_tokens": pt,
+        "pool_pages": layout.pool_pages,
+        "kv_pool_bytes": kv_pool_bytes,
+        "ttft_ms": round(ttft_ms, 2),
+        "per_token_ms": round(per_token_ms, 3) if per_token_ms else None,
+        "toks_per_sec": round(toks_per_sec, 1) if toks_per_sec else None,
+        "cache_donated": donated,
+    }
+
+
+def run_paged(bundle, params, cfg, batch, prompt_len, max_new, device, timed):
+    """Paged-decode record for the base config: TTFT (prefill + first
+    sample), steady-state tok/s through the page tables, and the donation
+    assertion (the prefill cache buffer must be consumed in place)."""
+    t = _paged_timing(bundle, params, cfg, batch, prompt_len, max_new, device)
+    rec = {
         "metric": "paged_decode_tokens_per_sec",
-        "value": round(toks_per_sec, 1) if toks_per_sec else None,
+        "value": t["toks_per_sec"],
         "unit": "tok/s",
         "platform": device.platform,
         "device_kind": device.device_kind,
         "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
-        "page_tokens": pt,
-        "pool_pages": layout.pool_pages,
-        "kv_pool_bytes": kv_pool_bytes,
+        "page_tokens": t["page_tokens"],
+        "pool_pages": t["pool_pages"],
+        "kv_pool_bytes": t["kv_pool_bytes"],
         "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
-        "ttft_ms": round(ttft_ms, 2),
-        "per_token_ms": round(per_token_ms, 3) if per_token_ms else None,
-        "cache_donated": donated,
+        "ttft_ms": t["ttft_ms"],
+        "per_token_ms": t["per_token_ms"],
+        "cache_donated": t["cache_donated"],
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def run_tensor_parallel(bundle, params, cfg, batch, prompt_len, max_new,
+                        device, single_rec):
+    """ISSUE 10 record: the SAME paged decode under the named 2-D serving
+    mesh (`batch`×`model`) — the seven projection kernels shard over
+    `model` via the bundle's sharding rules, concurrent rows split over
+    `batch`, and the page-table path is unchanged. On TPU the record must
+    prove the point of tensor parallelism (per-token latency improves vs
+    the single-chip paged record at fixed batch) and donation must
+    survive sharding; on CPU fake devices the collectives run over
+    shared memory, so both are report-only there. Emitted only when the
+    visible device count supports a model axis ≥ 2."""
+    import jax
+
+    from polyaxon_tpu.parallel.mesh import decode_mesh
+    from polyaxon_tpu.parallel.ring import set_current_mesh
+    from polyaxon_tpu.parallel.sharding import param_shardings
+
+    n_dev = jax.device_count()
+    axes = {
+        "batch": 2 if (n_dev >= 4 and batch % 2 == 0) else 1,
+        "model": 2,
+    }
+    mesh = decode_mesh(axes)
+    set_current_mesh(mesh)  # constrain() in the blocks needs it at trace
+    try:
+        tp_params = jax.device_put(
+            params, param_shardings(params, bundle.sharding_rules, mesh)
+        )
+        t = _paged_timing(
+            bundle, tp_params, cfg, batch, prompt_len, max_new, device
+        )
+    finally:
+        set_current_mesh(None)  # later records measure the single-chip path
+    single_ptm = (single_rec or {}).get("per_token_ms")
+    if device.platform == "tpu":
+        assert t["cache_donated"], (
+            "TP paged prefill cache was copied, not donated — sharding "
+            "broke donate_argnums"
+        )
+        if single_ptm and t["per_token_ms"]:
+            assert t["per_token_ms"] < single_ptm, (
+                f"tensor parallelism did not improve per-token latency: "
+                f"{t['per_token_ms']}ms sharded vs {single_ptm}ms single-chip"
+            )
+    print(json.dumps({
+        "metric": "tp_decode_tokens_per_sec",
+        "value": t["toks_per_sec"],
+        "unit": "tok/s",
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "model": f"dim={cfg['dim']} L={cfg['n_layers']}",
+        "mesh": {ax: mesh.shape.get(ax, 1) for ax in ("batch", "model")},
+        "mesh_devices": int(mesh.devices.size),
+        "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
+        "ttft_ms": t["ttft_ms"],
+        "per_token_ms": t["per_token_ms"],
+        "single_chip_per_token_ms": single_ptm,
+        "per_token_speedup_vs_single_chip": (
+            round(single_ptm / t["per_token_ms"], 2)
+            if single_ptm and t["per_token_ms"] else None
+        ),
+        "cache_donated": t["cache_donated"],
     }), flush=True)
 
 
@@ -501,8 +601,9 @@ def main(argv=None):
 
         if not is_base:
             continue
+        paged_rec = None
         try:
-            run_paged(
+            paged_rec = run_paged(
                 bundle, params, cfg, batch, prompt_len, max_new, device,
                 timed,
             )
@@ -511,6 +612,19 @@ def main(argv=None):
                 "metric": "paged_decode_tokens_per_sec",
                 "error": f"{type(e).__name__}: {e}"[:200],
             }), flush=True)
+        if jax.device_count() >= 2:
+            # tensor-parallel record (ISSUE 10) — needs a model axis of 2;
+            # single-device hosts (the CI smoke env) skip it entirely
+            try:
+                run_tensor_parallel(
+                    bundle, params, cfg, batch, prompt_len, max_new,
+                    device, paged_rec,
+                )
+            except Exception as e:  # noqa: BLE001 — report, keep sweeping
+                print(json.dumps({
+                    "metric": "tp_decode_tokens_per_sec",
+                    "error": f"{type(e).__name__}: {e}"[:200],
+                }), flush=True)
         try:
             # speculation amortizes over windows: give it a decode long
             # enough to leave the prefill-dominated regime (the smoke

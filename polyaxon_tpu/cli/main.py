@@ -787,10 +787,25 @@ def agent_drain(queues):
 @click.option("--no-trace", is_flag=True,
               help="disable per-request tracing (/tracez and X-Request-Id "
                    "correlation stay, but no span timelines are recorded)")
+@click.option("--replicas", default=None, type=int,
+              help="run N replica processes as a fleet-placed gang behind "
+                   "the router (default: the run spec's serving.replicas, "
+                   "else 1)")
+@click.option("--mesh-model", default=None, type=int,
+              help="shorthand for --mesh model=N: tensor-parallel the "
+                   "projection kernels over N chips per replica")
+@click.option("--route", is_flag=True,
+              help="front the replica(s) with the JSQ/P2C router "
+                   "(serving/router.py): health checks, shed retry on a "
+                   "sibling, rolling redeploy without an outage")
+@click.option("--autoscale-max", default=None, type=int,
+              help="router mode: scale replicas up to N on shed burn, "
+                   "back down when calm (default: fixed replica count)")
 def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
           expected_devices, kv_pool_pages, kv_page_tokens, no_prefix_cache,
-          no_stream, speculate, draft_tokens, quantize, no_trace):
+          no_stream, speculate, draft_tokens, quantize, no_trace,
+          replicas, mesh_model, route, autoscale_max):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
@@ -807,6 +822,8 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
             raise click.ClickException(
                 f"--mesh expects axis=N[,axis=N...], got {mesh!r}"
             )
+    if mesh_model is not None:
+        mesh_axes = {**(mesh_axes or {}), "model": mesh_model}
     # pass only the flags actually given: they layer over the run spec's
     # own `serving:` section (if any), which supplies every other knob
     overrides = {}
@@ -844,6 +861,16 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
     ):
         if value is not None:
             overrides[field] = value
+    if route or (replicas or 0) > 1:
+        _serve_fleet(
+            uid, host, port,
+            replicas=replicas,
+            mesh_axes=mesh_axes,
+            overrides=overrides,
+            expected_devices=expected_devices,
+            autoscale_max=autoscale_max,
+        )
+        return
     try:
         server = ModelServer.from_run(uid, mesh_axes=mesh_axes,
                                       config_overrides=overrides or None,
@@ -882,6 +909,145 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         # immediately; in-flight work gets drain_grace_s to finish
         click.echo("draining...")
         server.stop()
+
+
+# override-field → CLI flag spelling, for replica child processes
+_SERVE_FLAG_SPELLING = {
+    "max_batch": "--max-batch",
+    "max_wait_ms": "--max-wait-ms",
+    "max_queue": "--max-queue",
+    "default_deadline_ms": "--default-deadline-ms",
+    "drain_grace_s": "--drain-grace-s",
+    "breaker_threshold": "--breaker-threshold",
+    "kv_pool_pages": "--kv-pool-pages",
+    "kv_page_tokens": "--kv-page-tokens",
+    "draft_tokens": "--draft-tokens",
+}
+
+
+def _serve_child_argv(uid, port, mesh_axes, overrides, expected_devices):
+    """The single-replica `polyaxon serve` command line a replica child
+    runs — the SAME code path as one-replica serving, so fleet mode adds
+    no second serving implementation."""
+    argv = [sys.executable, "-m", "polyaxon_tpu.cli.main", "serve",
+            "-uid", uid, "--host", "127.0.0.1", "--port", str(port)]
+    if mesh_axes:
+        argv += ["--mesh", ",".join(f"{k}={v}" for k, v in mesh_axes.items())]
+    if expected_devices is not None:
+        argv += ["--expected-devices", str(expected_devices)]
+    for field, value in (overrides or {}).items():
+        if field == "prompt_buckets":
+            argv += ["--buckets", ",".join(str(b) for b in value)]
+        elif field == "batching" and value is False:
+            argv += ["--no-batching"]
+        elif field == "prefix_cache" and value is False:
+            argv += ["--no-prefix-cache"]
+        elif field == "stream" and value is False:
+            argv += ["--no-stream"]
+        elif field == "trace" and value is False:
+            argv += ["--no-trace"]
+        elif field in ("speculate", "quantize") and value:
+            argv += [f"--{field}"]
+        elif field in _SERVE_FLAG_SPELLING:
+            argv += [_SERVE_FLAG_SPELLING[field], str(value)]
+    return argv
+
+
+def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
+                 expected_devices, autoscale_max):
+    """`polyaxon serve --replicas N --route`: N single-replica children
+    as a fleet-placed gang, fronted by the JSQ/P2C router."""
+    from ..scheduler.fleet import Fleet
+    from ..serving.replicas import ReplicaSetManager, SubprocessReplica
+    from ..serving.router import AutoscalePolicy, Router
+    from ..telemetry import MetricsRegistry
+
+    store = RunStore()
+    try:
+        uuid = store.resolve(uid)
+    except KeyError as e:
+        raise click.ClickException(str(e.args[0]) if e.args else str(e))
+    # spec defaults: CLI flags layer over the run's own serving section
+    serving_spec = None
+    try:
+        from ..schemas.run_kinds import V1JAXJob
+
+        run = (store.read_spec(uuid).get("component") or {}).get("run") or {}
+        if run.get("kind") == "jaxjob" and run.get("program"):
+            serving_spec = V1JAXJob.model_validate(run).program.serving
+    except Exception:
+        pass
+    n = replicas or (
+        int(serving_spec.replicas)
+        if serving_spec is not None and isinstance(serving_spec.replicas, int)
+        else 1
+    )
+    if mesh_axes is None and serving_spec is not None:
+        mesh_axes = serving_spec.mesh_axes
+    chips = 1
+    if mesh_axes:
+        sizes = [int(v) for v in mesh_axes.values() if int(v) != -1]
+        import math as _math
+
+        chips = _math.prod(sizes) if sizes else 1
+
+    def factory(i):
+        return SubprocessReplica(
+            lambda p: _serve_child_argv(
+                uuid, p, mesh_axes, overrides, expected_devices
+            )
+        )
+
+    fleet = Fleet(store)
+    # one registry for manager + router so restart counters land on the
+    # same /metricsz scrape as the router_* series
+    registry = MetricsRegistry()
+    manager = ReplicaSetManager(
+        factory, replicas=n,
+        fleet=fleet if fleet.configured else None,
+        chips_per_replica=chips,
+        name=f"serve-{uuid[:8]}",
+        registry=registry,
+    )
+    autoscale = None
+    if autoscale_max is not None:
+        autoscale = AutoscalePolicy(min_replicas=n, max_replicas=autoscale_max)
+    router = Router(
+        manager.endpoints,
+        registry=registry,
+        scaler=manager if autoscale is not None else None,
+        autoscale=autoscale,
+    )
+    manager.attach_router(router)
+    click.echo(f"starting {n} replica(s)...")
+    try:
+        manager.start()
+    except Exception as e:
+        manager.stop(drain=False)
+        raise click.ClickException(f"replica startup failed: {e}")
+    bound = router.start(host=host, port=port)
+    mesh_note = (
+        " mesh=" + ",".join(f"{k}={v}" for k, v in (mesh_axes or {}).items())
+        if mesh_axes else ""
+    )
+    click.echo(
+        f"routing {n} replica(s){mesh_note} on http://{host}:{bound} — "
+        "POST /generate, GET /healthz, GET /readyz, GET /statsz, "
+        "GET /metricsz"
+        + (f"; autoscale up to {autoscale_max}" if autoscale_max else "")
+    )
+    import signal
+    import threading
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        click.echo("draining fleet...")
+        router.stop()
+        manager.stop()
 
 
 @cli.command()

@@ -388,7 +388,10 @@ class Attention(nn.Module):
         return _proj(cfg, cfg.dim, "o_proj")(out)
 
 
-BATCH = ("data", "fsdp")  # logical axes the batch dim may be split over
+# logical axes the batch dim may be split over: training meshes carry
+# data/fsdp, a serving decode mesh carries `batch` — constrain() degrades
+# whichever axes the live mesh lacks, so one set serves both paths
+BATCH = ("batch", "data", "fsdp")
 
 
 class FeedForward(nn.Module):

@@ -19,11 +19,17 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# outer→inner: DCN-tolerant axes first, latency-critical axes innermost
-AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "context", "model")
+# outer→inner: DCN-tolerant axes first, latency-critical axes innermost.
+# `batch` is the serving twin of `data`: a replica's decode mesh splits
+# concurrent sequences over it (no collectives), keeping `data`/`fsdp`
+# free to mean what they mean in training specs.
+AXIS_ORDER = ("batch", "pipeline", "data", "fsdp", "expert", "context", "model")
 
 # batch-sharded axes: the global batch dim is split across these
-BATCH_AXES = ("data", "fsdp")
+BATCH_AXES = ("batch", "data", "fsdp")
+
+# the serving mesh is deliberately 2-D — see decode_mesh()
+DECODE_AXES = ("batch", "model")
 
 
 def resolve_axis_sizes(
@@ -130,6 +136,57 @@ def _build_hybrid_mesh(sizes: dict[str, int], devices, slices: int) -> Mesh:
         shape[data_idx] = data
         dev_array = arr.reshape(tuple(shape))
     return Mesh(dev_array, axes)
+
+
+def decode_mesh(
+    spec_sizes: Optional[dict[str, int]] = None,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """Named 2-D serving mesh (`batch` × `model`) over a replica's chips.
+
+    Decode wants a fixed, explicit shape: `batch` splits concurrent
+    sequences (pure data parallelism — nothing on the per-token critical
+    path), `model` tensor-parallels the seven projection kernels so one
+    token's matmuls span chips. Axes beyond these two are rejected so the
+    serving compile-cache key stays 2-D. A replica may deliberately use
+    fewer chips than visible (the sizes multiply to less than the device
+    count): the mesh then takes the first prod(sizes) devices, which on
+    hardware are ICI-adjacent. No spec means one device, fully replicated
+    — the pre-mesh single-chip restore path, unchanged.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    sizes = {ax: int(n) for ax, n in (spec_sizes or {}).items()}
+    # legacy serve specs spelled batch-parallelism as data/fsdp (the
+    # training names); they fold into `batch` — same batch-dim split,
+    # one canonical serving mesh shape
+    folded = 1
+    for legacy in ("data", "fsdp"):
+        n = sizes.pop(legacy, 1)
+        folded = -1 if (n == -1 or folded == -1) else folded * n
+    if folded != 1:
+        if sizes.get("batch", 1) != 1:
+            raise ValueError(
+                "decode mesh: give `batch` OR legacy data/fsdp, not both"
+            )
+        sizes["batch"] = folded
+    bad = sorted(set(sizes) - set(DECODE_AXES))
+    if bad:
+        raise ValueError(
+            f"decode mesh allows axes {DECODE_AXES}, got extra {bad}"
+        )
+    if not sizes:
+        devices = devices[:1]
+    sizes.setdefault("batch", 1)
+    sizes.setdefault("model", 1)
+    if -1 in sizes.values():
+        sizes = resolve_axis_sizes(sizes, len(devices))
+    need = math.prod(sizes.values())
+    if need > len(devices):
+        raise ValueError(
+            f"decode mesh {sizes} needs {need} devices, "
+            f"only {len(devices)} visible"
+        )
+    return build_mesh(sizes, devices[:need])
 
 
 def local_batch_slice(mesh: Mesh) -> int:

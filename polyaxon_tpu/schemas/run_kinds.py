@@ -16,6 +16,7 @@ compiler (compiler/resolver.py).
 
 from __future__ import annotations
 
+import math
 from typing import Annotated, Any, Literal, Optional, Union
 
 from pydantic import Field, model_validator
@@ -162,9 +163,45 @@ class V1ServingSpec(BaseSchema):
     speculate: bool = False
     draft_tokens: int | str = 4
     quantize: bool = False
+    # horizontal serving (ISSUE 10): replicas is the fleet width (N
+    # gang-placed ModelServer processes behind serving/router.py);
+    # meshAxes is the per-replica decode mesh, e.g. {"batch": 2,
+    # "model": 2} — `model` tensor-parallels the projection kernels,
+    # `batch` splits concurrent sequences. Legacy specs may still spell
+    # batch-parallelism as data/fsdp; parallel.mesh.decode_mesh folds
+    # them into `batch`. -1 means "fill from the visible device count".
+    replicas: int | str = 1
+    mesh_axes: Optional[dict[str, int | str]] = None
+
+    _MESH_AXES_ALLOWED = ("batch", "model", "data", "fsdp")
 
     @model_validator(mode="after")
     def _check(self):
+        if isinstance(self.replicas, int) and self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.mesh_axes is not None:
+            if not self.mesh_axes:
+                raise ValueError("meshAxes must be a non-empty mapping")
+            fills = 0
+            for ax, n in self.mesh_axes.items():
+                if ax not in self._MESH_AXES_ALLOWED:
+                    raise ValueError(
+                        f"meshAxes axis {ax!r}: serving meshes are "
+                        f"`batch`×`model` (legacy data/fsdp fold into "
+                        f"batch); got axes {sorted(self.mesh_axes)}"
+                    )
+                if isinstance(n, int):
+                    if n == -1:
+                        fills += 1
+                    elif n < 1:
+                        raise ValueError(
+                            f"meshAxes[{ax!r}] must be >= 1 or -1 "
+                            f"(fill), got {n}"
+                        )
+            if fills > 1:
+                raise ValueError(
+                    "meshAxes allows at most one -1 (fill) axis"
+                )
         if isinstance(self.draft_tokens, int) and not (
             1 <= self.draft_tokens <= 16
         ):
@@ -218,7 +255,7 @@ class V1ServingSpec(BaseSchema):
         return self
 
     def to_config(self):
-        from ..serving.batching import ServingConfig
+        from ..serving.batching import ServingConfig, normalize_mesh_axes
 
         return ServingConfig(
             max_batch=int(self.max_batch),
@@ -251,7 +288,23 @@ class V1ServingSpec(BaseSchema):
             speculate=self.speculate,
             draft_tokens=int(self.draft_tokens),
             quantize=self.quantize,
+            mesh_axes=normalize_mesh_axes(
+                {ax: int(n) for ax, n in self.mesh_axes.items()}
+                if self.mesh_axes is not None
+                else None
+            ),
         )
+
+    def chips_needed(self) -> Optional[int]:
+        """Per-replica chip demand implied by meshAxes (None when the
+        mesh has a -1 fill axis or no mesh is pinned)."""
+        if not self.mesh_axes:
+            return None
+        sizes = list(self.mesh_axes.values())
+        # unresolved {{param}} interpolations or a -1 fill: not knowable
+        if any(not isinstance(n, int) for n in sizes) or -1 in sizes:
+            return None
+        return math.prod(sizes)
 
 
 class V1SLOSpec(BaseSchema):
@@ -458,6 +511,28 @@ class V1JAXJob(BaseSchema):
     def _check(self):
         if self.program is None and self.container is None:
             raise ValueError("jaxjob needs `program` (native) or `container`")
+        # serving meshAxes vs resources.chips: a pinned decode mesh that
+        # multiplies past the run's own chip request can never come up —
+        # reject at parse time, not at restore time on the serving host
+        serving = self.program.serving if self.program is not None else None
+        res = (
+            self.environment.resources
+            if self.environment is not None
+            else None
+        )
+        if serving is not None and res is not None:
+            need = serving.chips_needed()
+            have = (
+                res.tpu.total_chips
+                if res.tpu is not None
+                else res.chips
+            )
+            if need is not None and have is not None and need > have:
+                raise ValueError(
+                    f"serving.meshAxes {serving.mesh_axes} needs {need} "
+                    f"chips per replica, but resources request only "
+                    f"{have}"
+                )
         return self
 
 

@@ -6,5 +6,12 @@ from .batching import (  # noqa: F401
     ServingError,
     ShedError,
     WorkerCrashError,
+    normalize_mesh_axes,
 )
+from .replicas import (  # noqa: F401
+    InProcessReplica,
+    ReplicaSetManager,
+    SubprocessReplica,
+)
+from .router import AutoscalePolicy, P2CBalancer, Router  # noqa: F401
 from .server import ModelServer  # noqa: F401

@@ -197,11 +197,36 @@ class ServingConfig:
     # retain them in the server's tail-sampling TraceRing (/tracez)
     trace: bool = True
     trace_ring: int = 256  # recent-window capacity of the ring
+    # tensor-parallel decode (ISSUE 10): named 2-D mesh sizes as sorted
+    # (axis, size) pairs — hashable because the config is frozen and part
+    # of compile-cache identity; None = single-chip (pre-mesh behaviour).
+    # Only `batch`/`model` are legal (parallel.mesh.DECODE_AXES).
+    mesh_axes: Optional[tuple[tuple[str, int], ...]] = None
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
         nl = self.max_new_buckets or bucket_ladder(min(16, seq_len), seq_len)
         return tuple(sorted(pl)), tuple(sorted(nl))
+
+
+def normalize_mesh_axes(spec) -> Optional[tuple[tuple[str, int], ...]]:
+    """dict or pair-tuple → the frozen `ServingConfig.mesh_axes` form.
+
+    Sorted so `{'model': 2, 'batch': 1}` and `{'batch': 1, 'model': 2}`
+    produce one compile-cache identity. jax-free on purpose: schemas and
+    the CLI call this before any device exists."""
+    if not spec:
+        return None
+    pairs = sorted(
+        (str(ax), int(n))
+        for ax, n in (spec.items() if hasattr(spec, "items") else spec)
+    )
+    for ax, n in pairs:
+        if n < 1 and n != -1:
+            raise ValueError(f"mesh axis {ax}={n}: sizes are >=1 (or -1)")
+    if all(n == 1 for _, n in pairs):
+        return None  # a 1x1 mesh IS the single-chip path; keep one identity
+    return tuple(pairs)
 
 
 @dataclasses.dataclass(frozen=True)

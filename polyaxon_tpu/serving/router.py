@@ -1,0 +1,898 @@
+"""Fleet-facing HTTP router over N ModelServer replicas (ISSUE 10).
+
+One replica caps serving throughput at one coalescer and makes every
+redeploy an outage; the router is the horizontal layer that turns a set
+of replicas into one service. It is deliberately model-free — no jax
+import, no tokens parsed on the happy path — so it forwards bytes at
+HTTP speed while the replicas do the math:
+
+**Discovery + health** — a poll loop re-reads the endpoint provider
+(static list or `ReplicaSetManager.endpoints`) and probes each replica's
+`/readyz` and `/metricsz` every `poll_interval_s`. A replica is routable
+when ready and not marked draining; its scraped `serving_queue_depth`
+and the delta of `serving_queue_wait_seconds_sum/_count` between polls
+feed the balancer.
+
+**Balancing** — join-shortest-queue with power-of-two-choices: two
+distinct candidates are sampled (seeded RNG, deterministic in tests) and
+the one with the smaller (router-local in-flight + scraped queue depth,
+queue-wait) score wins. In-flight counts are the router's own, updated
+synchronously around each forward, so the signal does not stale between
+scrapes the way pure JSQ-on-metrics would.
+
+**Retry on sibling** — a 503 shed is, by the replica's own contract,
+"never queued, safe to retry" (serving/batching.py), so the router
+replays it on the next-best sibling instead of bouncing it to the
+client; likewise connection failures and worker-crash 500s (decode is
+deterministic, so the replay is idempotent). Deadline sheds are NOT
+retried — the deadline is just as expired on the sibling. Mid-stream
+failover replays the whole request on a sibling and trims the tokens
+each row already received (exact, because decode is byte-identical for
+a given seed), so a replica kill mid-SSE is invisible to the client.
+
+**Autoscale** — the PR 9 SLO burn-rate engine watches upstream sheds
+over router requests; a breach edge scales the replica set up (through
+`ReplicaSetManager.scale_to`), and a sustained calm window scales it
+back down. Both respect the policy's min/max and cooldown.
+
+Clocks: ONLY `telemetry.registry.now()` (the sanctioned monotonic
+metrics clock) — wall clocks would make queue-wait math and the burn
+engine lie across NTP steps (enforced by scripts/lint_telemetry.py
+rule 8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Sequence
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from ..telemetry import MetricsRegistry, new_trace_id, now as _now
+from ..telemetry.slo import AvailabilityObjective, SLOEngine
+
+# replica 503 reasons that must NOT be replayed on a sibling: the
+# request's own budget is spent, not the replica's
+_NO_RETRY_REASONS = frozenset({"deadline"})
+
+_PROM_LINE = re.compile(r"^([A-Za-z_:][\w:]*)\s+([0-9.eE+-]+|NaN)\s*$")
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Flat name → value from Prometheus text exposition (the registry
+    renders no labels, so a dict is lossless)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line.strip())
+        if m:
+            try:
+                out[m.group(1)] = float(m.group(2))
+            except ValueError:
+                pass
+    return out
+
+
+@dataclasses.dataclass
+class ReplicaState:
+    """What the router knows about one replica between polls."""
+
+    url: str  # base URL, e.g. http://127.0.0.1:8301
+    slug: str  # stable metric suffix, e.g. r0
+    healthy: bool = False
+    draining: bool = False  # rolling redeploy: routable = healthy & ~draining
+    queue_depth: float = 0.0  # scraped serving_queue_depth
+    queue_wait_ms: float = 0.0  # EWMA of scraped queue-wait deltas
+    inflight: int = 0  # router-local outstanding forwards
+    requests: int = 0  # forwards attempted at this replica
+    # last scraped cumulative queue-wait sums, for the delta
+    _wait_sum: float = 0.0
+    _wait_count: float = 0.0
+
+    @property
+    def routable(self) -> bool:
+        return self.healthy and not self.draining
+
+    def score(self) -> tuple[float, float]:
+        """JSQ key: shortest effective queue first, queue-wait tiebreak."""
+        return (self.inflight + self.queue_depth, self.queue_wait_ms)
+
+
+class P2CBalancer:
+    """Join-shortest-queue with power-of-two-choices: against stale
+    scrape data, sampling two and taking the shorter queue avoids the
+    thundering-herd-on-the-one-idle-replica failure of full JSQ while
+    staying within a constant factor of it. Seeded RNG: tests inject a
+    known seed and get a deterministic pick sequence."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def pick(self, candidates: Sequence[ReplicaState]) -> ReplicaState:
+        if not candidates:
+            raise ValueError("no candidates")
+        if len(candidates) <= 2:
+            return min(candidates, key=ReplicaState.score)
+        with self._lock:
+            two = self._rng.sample(list(candidates), 2)
+        return min(two, key=ReplicaState.score)
+
+    def order(
+        self, candidates: Sequence[ReplicaState]
+    ) -> list[ReplicaState]:
+        """First choice via P2C, then every remaining candidate by score
+        — the retry ladder walks this list."""
+        if not candidates:
+            return []
+        first = self.pick(candidates)
+        rest = sorted(
+            (c for c in candidates if c is not first),
+            key=ReplicaState.score,
+        )
+        return [first, *rest]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """When to grow/shrink the replica set. Scale-up rides the SLO
+    burn engine (shed fraction over router requests); scale-down needs
+    a sustained calm window so one quiet poll doesn't thrash."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    objective: float = 0.99  # <=1% of requests shed upstream
+    windows_s: tuple[float, ...] = (15.0, 60.0)
+    burn_threshold: float = 1.0
+    cooldown_s: float = 30.0  # min gap between scaling actions
+    calm_queue_wait_ms: float = 50.0  # every replica under this, and
+    calm_for_s: float = 120.0  # ...for this long → scale down
+
+
+class Router:
+    """The replica-fleet front door. `endpoints` is a static URL list or
+    a zero-arg callable returning one (ReplicaSetManager.endpoints) —
+    the poll loop re-reads it, so replicas that restart on new ports or
+    appear via autoscale are picked up within one poll interval."""
+
+    def __init__(
+        self,
+        endpoints,
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        balancer: Optional[P2CBalancer] = None,
+        poll_interval_s: float = 0.5,
+        probe_timeout_s: float = 2.0,
+        request_timeout_s: float = 600.0,
+        scaler=None,  # needs .scale_to(n) and .target (ReplicaSetManager)
+        autoscale: Optional[AutoscalePolicy] = None,
+    ):
+        self._provider: Callable[[], Sequence[str]] = (
+            endpoints if callable(endpoints) else (lambda: endpoints)
+        )
+        self.telemetry = registry or MetricsRegistry()
+        self.balancer = balancer or P2CBalancer()
+        self.poll_interval_s = float(poll_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self._states: list[ReplicaState] = []
+        self._rlock = threading.Lock()
+        self._m_requests = self.telemetry.counter(
+            "router.requests", help="Client requests accepted by the router"
+        )
+        self._m_retries = self.telemetry.counter(
+            "router.retries",
+            help="Forwards replayed on a sibling replica "
+            "(shed / connection failure / mid-stream failover)",
+        )
+        self._m_upstream_shed = self.telemetry.counter(
+            "router.upstream_shed",
+            help="503 sheds received from replicas (autoscale signal)",
+        )
+        self._m_errors = self.telemetry.counter(
+            "router.errors",
+            help="Requests that failed on every candidate replica",
+        )
+        self._m_latency = self.telemetry.histogram(
+            "router.request_seconds",
+            help="Router-side end-to-end request latency, seconds",
+        )
+        self._m_healthy_total = self.telemetry.gauge(
+            "router.replicas_routable",
+            help="Replicas currently healthy and not draining",
+        )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._stop_poll = threading.Event()
+        # autoscale (optional): shed-burn breach edge → scale up; calm
+        # window → scale down. The engine's gauges land on /metricsz.
+        self.scaler = scaler
+        self.autoscale = autoscale
+        self.slo_engine: Optional[SLOEngine] = None
+        self._last_scale_t = 0.0
+        self._calm_since: Optional[float] = None
+        if scaler is not None and autoscale is not None:
+            self.slo_engine = SLOEngine(
+                [
+                    AvailabilityObjective(
+                        "router-upstream-shed",
+                        autoscale.objective,
+                        bad=[self._m_upstream_shed],
+                        total=[self._m_requests],
+                        windows_s=autoscale.windows_s,
+                        burn_threshold=autoscale.burn_threshold,
+                    )
+                ],
+                self.telemetry,
+                on_breach=self._scale_up,
+            )
+        self.refresh()
+
+    # ---------------------------------------------------------- replicas
+    def refresh(self) -> None:
+        """Sync states with the provider; slugs are positional (r0, r1,
+        ...) so a replica restarted on a new port keeps its series."""
+        urls = list(self._provider())
+        with self._rlock:
+            for i, url in enumerate(urls):
+                if i < len(self._states):
+                    if self._states[i].url != url:
+                        self._states[i] = ReplicaState(url=url, slug=f"r{i}")
+                else:
+                    self._states.append(ReplicaState(url=url, slug=f"r{i}"))
+            del self._states[len(urls):]
+
+    def states(self) -> list[ReplicaState]:
+        with self._rlock:
+            return list(self._states)
+
+    def mark_draining(self, url: str, draining: bool = True) -> None:
+        """Rolling redeploy: take a replica out of rotation BEFORE its
+        drain starts, so no request races the admission close."""
+        with self._rlock:
+            for s in self._states:
+                if s.url == url:
+                    s.draining = draining
+
+    def _probe(self, s: ReplicaState) -> None:
+        try:
+            with urlrequest.urlopen(
+                s.url + "/readyz", timeout=self.probe_timeout_s
+            ) as r:
+                ready = json.loads(r.read()).get("ready", False)
+        except urlerror.HTTPError as e:
+            # /readyz answers 503 with the same body while draining
+            try:
+                ready = bool(json.loads(e.read()).get("ready", False))
+            except Exception:
+                ready = False
+        except Exception:
+            s.healthy = False
+            return
+        s.healthy = bool(ready)
+        try:
+            with urlrequest.urlopen(
+                s.url + "/metricsz", timeout=self.probe_timeout_s
+            ) as r:
+                metrics = parse_prometheus(r.read().decode())
+        except Exception:
+            return  # keep last-known queue signal
+        s.queue_depth = metrics.get("serving_queue_depth", 0.0)
+        wsum = metrics.get("serving_queue_wait_seconds_sum", 0.0)
+        wcount = metrics.get("serving_queue_wait_seconds_count", 0.0)
+        dc = wcount - s._wait_count
+        if dc > 0:
+            delta_ms = 1000.0 * (wsum - s._wait_sum) / dc
+            # EWMA so one anomalous poll doesn't own the routing decision
+            s.queue_wait_ms = (
+                delta_ms
+                if s._wait_count == 0
+                else 0.5 * s.queue_wait_ms + 0.5 * delta_ms
+            )
+        s._wait_sum, s._wait_count = wsum, wcount
+
+    def poll_once(self) -> None:
+        """One discovery + health pass (the loop body; tests call it
+        directly for determinism)."""
+        self.refresh()
+        for s in self.states():
+            self._probe(s)
+            self.telemetry.gauge(
+                f"router.replica_healthy.{s.slug}",
+                help="1 when the replica is ready and routable",
+            ).set(1.0 if s.routable else 0.0)
+            self.telemetry.gauge(
+                f"router.replica_queue_wait_ms.{s.slug}",
+                help="Scraped queue-wait EWMA driving JSQ, milliseconds",
+            ).set(round(s.queue_wait_ms, 3))
+            self.telemetry.gauge(
+                f"router.replica_queue_depth.{s.slug}",
+                help="Scraped coalescer queue depth",
+            ).set(s.queue_depth)
+        self._m_healthy_total.set(
+            sum(1 for s in self.states() if s.routable)
+        )
+        self._autoscale_tick()
+
+    def _poll_loop(self) -> None:
+        while not self._stop_poll.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # discovery must outlive any one bad poll
+
+    # --------------------------------------------------------- autoscale
+    def _scale_up(self, breach: dict) -> None:
+        if self.scaler is None or self.autoscale is None:
+            return
+        t = _now()
+        if t - self._last_scale_t < self.autoscale.cooldown_s:
+            return
+        target = min(self.autoscale.max_replicas, self.scaler.target + 1)
+        if target > self.scaler.target:
+            self._last_scale_t = t
+            self._calm_since = None
+            self.scaler.scale_to(target)
+
+    def _autoscale_tick(self) -> None:
+        if self.slo_engine is not None:
+            self.slo_engine.evaluate()  # breach edge calls _scale_up
+        if self.scaler is None or self.autoscale is None:
+            return
+        pol = self.autoscale
+        states = self.states()
+        calm = (
+            len(states) > 0
+            and all(s.routable for s in states)
+            and all(s.queue_wait_ms <= pol.calm_queue_wait_ms for s in states)
+            and all(s.inflight + s.queue_depth == 0 for s in states)
+        )
+        t = _now()
+        if not calm:
+            self._calm_since = None
+            return
+        if self._calm_since is None:
+            self._calm_since = t
+            return
+        if (
+            t - self._calm_since >= pol.calm_for_s
+            and t - self._last_scale_t >= pol.cooldown_s
+            and self.scaler.target > pol.min_replicas
+        ):
+            self._last_scale_t = t
+            self._calm_since = None
+            self.scaler.scale_to(self.scaler.target - 1)
+
+    # -------------------------------------------------------- forwarding
+    def _candidates(self) -> list[ReplicaState]:
+        with self._rlock:
+            routable = [s for s in self._states if s.routable]
+            # nothing probed healthy yet (cold start): try them all
+            # rather than bouncing the request
+            return routable or [
+                s for s in self._states if not s.draining
+            ] or list(self._states)
+
+    def forward(
+        self, body: bytes, rid: str, *, query: str = ""
+    ) -> tuple[int, bytes, dict]:
+        """Non-streaming forward: returns (status, payload bytes,
+        headers) of the first acceptable upstream answer — payload bytes
+        verbatim, so the client sees exactly what the replica wrote."""
+        order = self.balancer.order(self._candidates())
+        if not order:
+            return 503, json.dumps(
+                {"error": "router: no replicas", "reason": "no_replicas"}
+            ).encode(), {}
+        last: tuple[int, bytes, dict] = (
+            502,
+            json.dumps(
+                {"error": "router: all replicas failed", "reason": "upstream"}
+            ).encode(),
+            {},
+        )
+        for i, s in enumerate(order):
+            if i > 0:
+                self._m_retries.inc()
+            status, payload, headers = self._forward_once(s, body, rid, query)
+            retryable = self._retryable(status, payload)
+            if not retryable:
+                return status, payload, headers
+            last = (status, payload, headers)
+        self._m_errors.inc()
+        return last
+
+    def _retryable(self, status: int, payload: bytes) -> bool:
+        if status in (502, 599):  # router-synthesized connection failure
+            return True
+        if status == 500:
+            return True  # worker crash; decode is deterministic → idempotent
+        if status == 503:
+            self._m_upstream_shed.inc()
+            try:
+                reason = json.loads(payload).get("reason")
+            except Exception:
+                reason = None
+            return reason not in _NO_RETRY_REASONS
+        return False
+
+    def _forward_once(
+        self, s: ReplicaState, body: bytes, rid: str, query: str
+    ) -> tuple[int, bytes, dict]:
+        url = s.url + "/generate" + (f"?{query}" if query else "")
+        req = urlrequest.Request(
+            url,
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": rid,
+            },
+            method="POST",
+        )
+        with self._rlock:
+            s.inflight += 1
+            s.requests += 1
+        try:
+            with urlrequest.urlopen(
+                req, timeout=self.request_timeout_s
+            ) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urlerror.HTTPError as e:
+            try:
+                payload = e.read()
+            except Exception:
+                payload = b"{}"
+            return e.code, payload, dict(e.headers or {})
+        except Exception as e:  # URLError, ConnectionError, timeout
+            return 599, json.dumps(
+                {"error": f"router: {type(e).__name__}: {e}",
+                 "reason": "connect"}
+            ).encode(), {}
+        finally:
+            with self._rlock:
+                s.inflight -= 1
+
+    # -------------------------------------------------------- streaming
+    def forward_stream(self, body: bytes, rid: str, *, query: str = ""):
+        """Generator of raw SSE frame bytes, with mid-stream failover.
+
+        The happy path relays the replica's frames VERBATIM (byte
+        identity with a direct request holds because the replica embeds
+        the same X-Request-Id). Every frame is also parsed to track how
+        many tokens each row has already received; when an upstream dies
+        mid-stream — connection drop or the in-band row-less error frame
+        — the whole request replays on the next sibling and each row's
+        already-delivered prefix is trimmed (decode is deterministic per
+        seed, so the replay's tokens match what the dead replica sent).
+
+        Raises _StreamError(status, payload, headers) if no upstream
+        could even start a stream; yields frames otherwise.
+        """
+        sent: dict[int, int] = {}  # row → tokens already delivered
+        done_rows: set[int] = set()
+        order = self.balancer.order(self._candidates())
+        if not order:
+            raise _StreamError(
+                503,
+                json.dumps(
+                    {"error": "router: no replicas", "reason": "no_replicas"}
+                ).encode(),
+                {},
+            )
+        started = False
+        last_err: Optional[_StreamError] = None
+        for i, s in enumerate(order):
+            if i > 0:
+                self._m_retries.inc()
+            try:
+                gen = self._stream_once(s, body, rid, query, sent, done_rows)
+                for frame in gen:
+                    started = True
+                    yield frame
+                return  # terminal {"done": true} seen
+            except _StreamError as e:
+                if not e.retryable:
+                    if started:
+                        break  # can't re-raise a status mid-stream
+                    raise
+                last_err = e
+                continue
+        # every sibling failed
+        self._m_errors.inc()
+        if started:
+            yield (
+                b"data: "
+                + json.dumps(
+                    {"error": "router: upstream lost mid-stream and no "
+                     "sibling could resume", "requestId": rid}
+                ).encode()
+                + b"\n\n"
+            )
+            return
+        raise last_err if last_err is not None else _StreamError(
+            502,
+            json.dumps(
+                {"error": "router: all replicas failed", "reason": "upstream"}
+            ).encode(),
+            {},
+        )
+
+    def _stream_once(
+        self,
+        s: ReplicaState,
+        body: bytes,
+        rid: str,
+        query: str,
+        sent: dict[int, int],
+        done_rows: set[int],
+    ):
+        q = query or "stream=1"
+        if "stream=1" not in q.split("&"):
+            q += "&stream=1"
+        req = urlrequest.Request(
+            s.url + "/generate?" + q,
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": rid,
+            },
+            method="POST",
+        )
+        with self._rlock:
+            s.inflight += 1
+            s.requests += 1
+        try:
+            try:
+                resp = urlrequest.urlopen(req, timeout=self.request_timeout_s)
+            except urlerror.HTTPError as e:
+                try:
+                    payload = e.read()
+                except Exception:
+                    payload = b"{}"
+                raise _StreamError(
+                    e.code,
+                    payload,
+                    dict(e.headers or {}),
+                    retryable=self._retryable(e.code, payload),
+                )
+            except _StreamError:
+                raise
+            except Exception as e:
+                raise _StreamError(
+                    599,
+                    json.dumps(
+                        {"error": f"router: {type(e).__name__}: {e}",
+                         "reason": "connect"}
+                    ).encode(),
+                    {},
+                    retryable=True,
+                )
+            with resp:
+                seen: dict[int, int] = {}  # row → tokens THIS attempt
+                finished = False
+                for frame in _iter_sse_frames(resp):
+                    ev = _parse_frame(frame)
+                    if ev is None:
+                        continue
+                    if "error" in ev:
+                        # replica-side failure, whole-stream (row-less
+                        # frame) or per-row (worker crash / decode error
+                        # scatters {"row": i, "error": ...} to every
+                        # row): fail over — the sibling replays, rows
+                        # already finished dedup via done_rows, and the
+                        # client never sees the error
+                        raise _StreamError(
+                            500, frame, {}, retryable=True
+                        )
+                    row = ev.get("row")
+                    if row is not None and "tokens" in ev:
+                        toks = ev["tokens"]
+                        have = sent.get(row, 0)
+                        seen[row] = seen.get(row, 0) + len(toks)
+                        if seen[row] <= have:
+                            continue  # replay of already-delivered tokens
+                        fresh = toks[-(seen[row] - have):]
+                        sent[row] = have + len(fresh)
+                        if len(fresh) == len(toks):
+                            yield frame  # verbatim: the byte-identity path
+                        else:
+                            yield (
+                                b"data: "
+                                + json.dumps(
+                                    {**ev, "tokens": fresh}
+                                ).encode()
+                                + b"\n\n"
+                            )
+                        continue
+                    if row is not None and ev.get("done"):
+                        if row in done_rows:
+                            continue
+                        done_rows.add(row)
+                        yield frame
+                        continue
+                    if ev.get("done"):
+                        finished = True
+                        yield frame
+                        break
+                    yield frame  # future event kinds: relay verbatim
+                if not finished:
+                    raise _StreamError(
+                        599,
+                        json.dumps(
+                            {"error": "router: upstream closed mid-stream",
+                             "reason": "connect"}
+                        ).encode(),
+                        {},
+                        retryable=True,
+                    )
+        finally:
+            with self._rlock:
+                s.inflight -= 1
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        lat = self._m_latency.summary()
+        replicas = [
+            {
+                "url": s.url,
+                "slug": s.slug,
+                "healthy": s.healthy,
+                "draining": s.draining,
+                "queue_depth": s.queue_depth,
+                "queue_wait_ms": round(s.queue_wait_ms, 3),
+                "inflight": s.inflight,
+                "requests": s.requests,
+            }
+            for s in self.states()
+        ]
+        auto = {"enabled": self.slo_engine is not None}
+        if self.autoscale is not None:
+            auto.update(
+                min_replicas=self.autoscale.min_replicas,
+                max_replicas=self.autoscale.max_replicas,
+            )
+        if self.scaler is not None:
+            auto["target"] = self.scaler.target
+        return {
+            "role": "router",
+            "replicas": replicas,
+            "routable": sum(1 for s in self.states() if s.routable),
+            "requests": int(self._m_requests.value),
+            "retries": int(self._m_retries.value),
+            "upstream_shed": int(self._m_upstream_shed.value),
+            "errors": int(self._m_errors.value),
+            "latency_ms": {
+                k: (round(lat[k] * 1000.0, 3) if lat[k] is not None else None)
+                for k in ("p50", "p95", "p99", "mean")
+            },
+            "autoscale": auto,
+        }
+
+    def readiness(self) -> tuple[bool, str]:
+        n = sum(1 for s in self.states() if s.routable)
+        if n == 0:
+            return False, "no routable replica"
+        return True, "ok"
+
+    # -------------------------------------------------------------- http
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        router = self
+        self._stop_poll.clear()
+        self.poll_once()  # synchronous first pass: routable before bound
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="router-poll", daemon=True
+        )
+        self._poll_thread.start()
+        if self.slo_engine is not None:
+            self.slo_engine.start()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, payload, headers=None):
+                data = json.dumps(payload).encode()
+                self._send_raw(code, data, "application/json", headers)
+
+            def _send_raw(self, code, data, ctype, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path, _, _query = self.path.partition("?")
+                if path == "/healthz":
+                    self._send(
+                        200,
+                        {
+                            "status": "ok",
+                            "role": "router",
+                            "replicas": len(router.states()),
+                        },
+                    )
+                elif path == "/readyz":
+                    ready, reason = router.readiness()
+                    self._send(
+                        200 if ready else 503,
+                        {"ready": ready, "reason": reason},
+                    )
+                elif path == "/statsz":
+                    self._send(200, router.stats())
+                elif path == "/metricsz":
+                    self._send_raw(
+                        200,
+                        router.telemetry.render_prometheus().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif path == "/sloz":
+                    self._send(
+                        200,
+                        router.slo_engine.to_dict()
+                        if router.slo_engine is not None
+                        else {"enabled": False, "breached": False, "slos": []},
+                    )
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path != "/generate":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                rid = (
+                    (self.headers.get("X-Request-Id") or "").strip()[:128]
+                    or new_trace_id()
+                )
+                router._m_requests.inc()
+                t0 = _now()
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    if "stream=1" in query.split("&"):
+                        self._relay_stream(body, rid, query)
+                    else:
+                        status, payload, headers = router.forward(
+                            body, rid, query=query
+                        )
+                        fwd = {
+                            k: v
+                            for k, v in headers.items()
+                            if k in ("Retry-After", "X-Request-Id")
+                        }
+                        fwd.setdefault("X-Request-Id", rid)
+                        self._send_raw(
+                            status, payload, "application/json", fwd
+                        )
+                except BrokenPipeError:
+                    pass  # client went away; nothing to answer
+                except Exception as e:  # noqa: BLE001 — surface, don't kill
+                    router._m_errors.inc()
+                    try:
+                        self._send(
+                            500,
+                            {
+                                "error": f"router: {type(e).__name__}: {e}",
+                                "reason": "internal",
+                            },
+                        )
+                    except OSError:
+                        pass
+                finally:
+                    router._m_latency.observe(_now() - t0, exemplar=rid)
+
+            def _relay_stream(self, body, rid, query):
+                gen = router.forward_stream(body, rid, query=query)
+                try:
+                    first = next(gen)  # admission errors raise here
+                except _StreamError as e:
+                    fwd = {
+                        k: v
+                        for k, v in e.headers.items()
+                        if k in ("Retry-After", "X-Request-Id")
+                    }
+                    fwd.setdefault("X-Request-Id", rid)
+                    self._send_raw(
+                        e.status, e.payload, "application/json", fwd
+                    )
+                    return
+                except StopIteration:
+                    self._send(502, {"error": "router: empty stream"})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-store")
+                self.send_header("Connection", "close")
+                self.send_header("X-Request-Id", rid)
+                self.end_headers()
+                import itertools
+
+                try:
+                    for frame in itertools.chain((first,), gen):
+                        self.wfile.write(frame)
+                        self.wfile.flush()
+                except BrokenPipeError:
+                    pass
+
+        self._httpd = _RouterHttpd((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="router-http", daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        self._stop_poll.set()
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+            self._poll_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class _RouterHttpd(ThreadingHTTPServer):
+    # same rationale as serving/_Httpd: under a burst the router's whole
+    # job is to keep accepting, balancing, and (maybe) shedding fast
+    daemon_threads = True
+    request_queue_size = 128
+
+
+class _StreamError(Exception):
+    """A streaming forward failed before/mid relay; carries the upstream
+    answer so the HTTP layer can relay real status codes."""
+
+    def __init__(
+        self,
+        status: int,
+        payload: bytes,
+        headers: dict,
+        *,
+        retryable: bool = False,
+    ):
+        super().__init__(f"upstream {status}")
+        self.status = status
+        self.payload = payload
+        self.headers = headers
+        self.retryable = retryable
+
+
+def _iter_sse_frames(resp):
+    """Yield complete `data: ...\\n\\n` frames from a streaming response.
+    EOF mid-frame simply stops iteration — the caller decides whether the
+    stream was terminal (it tracks the final done event)."""
+    buf = b""
+    while True:
+        line = resp.readline()
+        if not line:
+            return
+        buf += line
+        if line == b"\n" and buf.strip():
+            yield buf
+            buf = b""
+
+
+def _parse_frame(frame: bytes) -> Optional[dict]:
+    for line in frame.splitlines():
+        if line.startswith(b"data: "):
+            try:
+                return json.loads(line[len(b"data: "):])
+            except ValueError:
+                return None
+    return None

@@ -188,6 +188,8 @@ class ModelServer:
         slos: Optional[list] = None,
         debug_dir: Optional[str] = None,
         slo_profile_s: float = 0.0,
+        sharding_rules: tuple = (),
+        mesh=None,
     ):
         self.config = config or ServingConfig()
         # int8 quantize-on-load (ISSUE 8): rebuild the module with the
@@ -200,6 +202,29 @@ class ModelServer:
 
             module, params, self._quant_bytes_saved = quantize_module(
                 module, params
+            )
+        # tensor-parallel decode (ISSUE 10): a named 2-D `batch`×`model`
+        # mesh. from_run passes the mesh it restored onto (params already
+        # land sharded); direct construction builds one from
+        # config.mesh_axes and shards the given params here. device_put
+        # onto an already-matching sharding is a no-op, so both paths
+        # share this block.
+        self._sharding_rules = tuple(sharding_rules or ())
+        self._mesh = mesh
+        if self._mesh is None and self.config.mesh_axes:
+            from ..parallel.mesh import decode_mesh
+
+            self._mesh = decode_mesh(dict(self.config.mesh_axes))
+        if self._mesh is not None:
+            import jax
+
+            from ..parallel.ring import set_current_mesh
+            from ..parallel.sharding import param_shardings
+
+            set_current_mesh(self._mesh)
+            params = jax.device_put(
+                params,
+                param_shardings(params, self._sharding_rules, self._mesh),
             )
         self.module = module
         self.params = params
@@ -268,6 +293,25 @@ class ModelServer:
             help="Readiness (/readyz): 1 accepting, 0 draining/degraded",
         )
         self._m_ready.set(0)
+        # router balancing signal (ISSUE 10): unfinished requests admitted
+        # to the coalescer, refreshed at scrape time — join-shortest-queue
+        # reads this off /metricsz
+        self._m_queue_depth = self.telemetry.gauge(
+            "serving.queue_depth",
+            help="Unfinished requests admitted to the coalescer queue",
+        )
+        self._m_mesh_devices = self.telemetry.gauge(
+            "serving.mesh_devices",
+            help="Devices in this replica's decode mesh (1 = single-chip)",
+        )
+        self._m_mesh_model = self.telemetry.gauge(
+            "serving.mesh_model",
+            help="Tensor-parallel (`model` axis) degree of the decode mesh",
+        )
+        self._m_mesh_devices.set(self._mesh.devices.size if self._mesh is not None else 1)
+        self._m_mesh_model.set(
+            self._mesh.shape.get("model", 1) if self._mesh is not None else 1
+        )
         # paged KV + streaming series (ISSUE 6) — registered from startup
         # (zeros when the pool is off) so the canary's KV gate can scrape
         # them unconditionally
@@ -657,7 +701,7 @@ class ModelServer:
         import jax
 
         from ..models import build_model
-        from ..parallel.mesh import build_mesh
+        from ..parallel.mesh import decode_mesh
         from ..parallel.ring import set_current_mesh
         from ..parallel.sharding import param_shardings
         from ..runtime.trainer import make_param_init, param_dtype_for
@@ -685,6 +729,15 @@ class ModelServer:
                 config if config is not None else ServingConfig(),
                 **config_overrides,
             )
+        if mesh_axes:
+            # the CLI --mesh flag is an override like any other knob: it
+            # layers over the spec's meshAxes without resetting it to None
+            from .batching import normalize_mesh_axes
+
+            config = dataclasses.replace(
+                config if config is not None else ServingConfig(),
+                mesh_axes=normalize_mesh_axes(mesh_axes),
+            )
         # absolute: orbax's CheckpointManager rejects relative paths, and a
         # store rooted at a relative POLYAXON_HOME (CLI run from the store's
         # parent dir) would otherwise fail only at serve time
@@ -701,9 +754,10 @@ class ModelServer:
         tspec = program.train
         seed = int(tspec.seed) if tspec else 0
         precision = tspec.precision if tspec else "mixed"
-        mesh = build_mesh(
-            mesh_axes, devices=None if mesh_axes else [jax.devices()[0]]
-        )
+        axes = config.mesh_axes if config is not None else None
+        # the named 2-D serving mesh (`batch`×`model`); no axes = the
+        # single-chip path on device 0, exactly the pre-mesh behaviour
+        mesh = decode_mesh(dict(axes) if axes else None)
         set_current_mesh(mesh)  # decode-time sharding constraints need it
         # the trainer's own init recipe → identical abstract tree, no drift
         init_fn = make_param_init(
@@ -738,6 +792,8 @@ class ModelServer:
             debug_dir=(
                 str(store.outputs_dir(uuid) / "debug") if slos else None
             ),
+            sharding_rules=bundle.sharding_rules,
+            mesh=mesh,
         )
 
     # --------------------------------------------------------- validation
@@ -1515,7 +1571,20 @@ class ModelServer:
                 )
         self._m_requests.inc(len(batch))
 
+    def _bind_mesh(self) -> None:
+        """Re-assert the decode mesh in THIS thread. set_current_mesh is
+        thread-local (parallel.ring), so the mesh bound while restoring in
+        the loading thread is invisible to the coalescer's worker thread
+        and to HTTP handler threads — without this, constrain() silently
+        degrades to no-ops at trace time and decode runs unsharded."""
+        if self._mesh is not None:
+            from ..parallel.ring import current_mesh, set_current_mesh
+
+            if current_mesh() is not self._mesh:
+                set_current_mesh(self._mesh)
+
     def _dispatch_group(self, batch: list[PendingRequest]):
+        self._bind_mesh()
         key = batch[0].key
         if key.num_beams > 1:
             self._execute_beam_group(batch)
@@ -1536,6 +1605,7 @@ class ModelServer:
         import jax.numpy as jnp
         import numpy as np
 
+        self._bind_mesh()
         req = self._validate(body)
         arr = req["arr"]
         if req["num_beams"] > 1 or not self.config.batching:
@@ -1860,7 +1930,15 @@ class ModelServer:
         )
         if self.flight_recorder is not None:
             slo["flight_recorder_dumps"] = self.flight_recorder.dumps
+        mesh = {"enabled": False, "devices": 1}
+        if self._mesh is not None:
+            mesh = {
+                "enabled": self._mesh.devices.size > 1,
+                "devices": int(self._mesh.devices.size),
+                "axes": {k: int(v) for k, v in self._mesh.shape.items()},
+            }
         return {
+            "mesh": mesh,
             "kv": kv,
             "speculation": speculation,
             "quant": quant,
@@ -1949,6 +2027,10 @@ class ModelServer:
                 elif path == "/statsz":
                     self._send(200, server.stats())
                 elif path == "/metricsz":
+                    # scrape-time refresh: the router's JSQ signal must
+                    # reflect the queue NOW, not the last admission event
+                    if server._coalescer is not None:
+                        server._m_queue_depth.set(server._coalescer.depth)
                     self._send_raw(
                         200,
                         server.telemetry.render_prometheus().encode(),

@@ -65,6 +65,17 @@ those two files is forbidden. The rest of `polyaxon_tpu/telemetry/`
 stays exempt (registry.py DEFINES the clock; spans.py stamps wall-clock
 `ts` for log correlation by design).
 
+Eighth rule: NO raw clock in the serving router. The router
+(`polyaxon_tpu/serving/router.py`) balances on queue-wait deltas it
+scrapes off replica /metricsz and feeds its own latency histogram and
+the autoscale burn engine — all of which live on the telemetry clock
+(`registry.now`). A `time.time()`/`datetime.now()` (or `time.monotonic`
+outside the sanctioned helper) read there would mix a second clock into
+the balancing signal and the burn windows: NTP steps would reorder
+replicas and flap the autoscaler. The router must take time ONLY from
+`telemetry.now()`, so any direct `time.*` / `datetime.now/utcnow/today`
+call in that file is forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -108,6 +119,13 @@ SLO_MODULES = (
     ("polyaxon_tpu", "telemetry", "slo.py"),
     ("polyaxon_tpu", "telemetry", "tracing.py"),
 )
+ROUTER_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+ROUTER_MODULES = (
+    ("polyaxon_tpu", "serving", "router.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -137,6 +155,7 @@ def violations(repo_root: Path) -> list[str]:
         in_kv = rel.parts in KV_MODULES
         in_ckpt = rel.parts in CKPT_MODULES
         in_spec = rel.parts in SPEC_MODULES
+        in_router = rel.parts in ROUTER_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -170,6 +189,12 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: raw clock in the fast-decode path — "
                     f"speculation/quant order by logical generation "
                     f"index only: {line.strip()}"
+                )
+            if in_router and ROUTER_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in the serving router — "
+                    f"balancing and autoscale burn must ride "
+                    f"telemetry.now() only: {line.strip()}"
                 )
     return out
 

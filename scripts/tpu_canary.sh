@@ -596,6 +596,167 @@ PY
       echo "SLO-TRACE-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # router gate: 2 replicas behind the fleet router, warm traffic,
+    # then a worker kill injected mid-stream. The router must fail the
+    # stream over to the sibling with ZERO client-visible failures
+    # (byte-complete greedy tokens, no error frames) and count at least
+    # one retry; the router_* series must be live on /metricsz. A
+    # horizontal deployment whose failover or telemetry is dark FAILS.
+    echo "running router failover smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.chaos.injector import active
+from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.retry import RetryPolicy
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.replicas import InProcessReplica, ReplicaSetManager
+from polyaxon_tpu.serving.router import P2CBalancer, Router
+from polyaxon_tpu.serving.server import ModelServer
+from polyaxon_tpu.telemetry import MetricsRegistry
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+
+
+def make_server():
+    return ModelServer(
+        b.module, params,
+        config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                             kv_pool_pages=64, kv_page_tokens=8,
+                             stream_chunk_tokens=3),
+    )
+
+
+# one registry: the manager's replica-fleet gauges and the router's
+# routing series land on the SAME /metricsz the gate scrapes
+reg = MetricsRegistry()
+mgr = ReplicaSetManager(
+    lambda i: InProcessReplica(make_server), replicas=2,
+    retry=RetryPolicy(max_retries=3, backoff=0.1),
+    registry=reg, monitor_interval_s=0.2,
+)
+router = Router(
+    mgr.endpoints, registry=reg, balancer=P2CBalancer(seed=7),
+    poll_interval_s=0.2,
+)
+mgr.attach_router(router)
+mgr.start()
+port = router.start("127.0.0.1", 0)
+failures = []
+try:
+    router.poll_once()
+
+    def post(body, path="/generate"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "canary-router"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            if r.status != 200:
+                failures.append((path, r.status))
+            return r.read()
+
+    greedy = {"tokens": [list(range(1, 13))], "maxNewTokens": 8,
+              "temperature": 0.0, "seed": 0}
+    sampled = {**greedy, "temperature": 0.8, "topK": 40}
+
+    def stream_tokens(raw):
+        toks, errs = [], []
+        for frame in raw.split(b"\n\n"):
+            if not frame.startswith(b"data: "):
+                continue
+            ev = json.loads(frame[len(b"data: "):])
+            if "error" in ev:
+                errs.append(ev)
+            if "tokens" in ev and ev.get("row") == 0:
+                toks.extend(ev["tokens"])
+        return toks, errs
+
+    # warm traffic: both paths, both replicas compile their buckets
+    for _ in range(4):
+        post(greedy)
+        post(sampled)
+    reference, errs = stream_tokens(post(greedy, "/generate?stream=1"))
+    if errs or not reference:
+        print("router smoke: warm stream failed", errs)
+        sys.exit(1)
+
+    retries_before = router._m_retries.value
+    # the injected worker kill crashes whichever replica the stream
+    # landed on mid-decode; the router must replay on the sibling
+    with active(FaultPlan([Fault("serving.worker", "kill", at=0)])):
+        failed_over, errs = stream_tokens(post(greedy, "/generate?stream=1"))
+    retries = router._m_retries.value - retries_before
+    if errs:
+        print("router smoke: client saw error frames through failover", errs)
+        sys.exit(1)
+    if failed_over != reference:
+        print("router smoke: failover stream diverged",
+              failed_over, reference)
+        sys.exit(1)
+    if retries < 1:
+        print("router smoke: worker kill produced no router retry")
+        sys.exit(1)
+
+    # crashed-replica recovery: kill a replica outright; the manager
+    # must relaunch it into the same slot while the router keeps serving
+    mgr.replica(0).kill()
+    post(greedy)  # served by the survivor
+    deadline = time.monotonic() + 60
+    while mgr.live() < 2 and time.monotonic() < deadline:
+        time.sleep(0.2)
+    if mgr.live() != 2:
+        print("router smoke: killed replica was not relaunched")
+        sys.exit(1)
+
+    if failures:
+        print("router smoke: non-200 responses", failures)
+        sys.exit(1)
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+finally:
+    router.stop()
+    mgr.stop()
+with open("tpu_results/router_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "router_requests_total",
+    "router_retries_total",
+    "router_upstream_shed_total",
+    "router_errors_total",
+    "router_replicas_routable",
+    "router_request_seconds_bucket",
+    "serving_replica_restarts_total",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("router smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"router failover smoke: ok ({len(required)} required series "
+      f"present, {retries} retries, zero failed requests, "
+      f"replica relaunched)")
+PY
+    then
+      echo "ROUTER-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

@@ -202,6 +202,40 @@ def test_serving_bench_trace_overhead_schema(tmp_home):
     assert r["value"] <= 5.0, r
 
 
+def test_serving_bench_router_schema(tmp_home):
+    proc = _run(
+        "benchmarks/serving_bench.py", "--smoke", "--router",
+        "--replicas", "2", timeout=560,
+    )
+    # rc=1 is the script's own gate (overhead > 10%, scaling below 1.7x
+    # where enforced, or a byte-identity break) — fail loudly
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    recs = {r["metric"]: r for r in _records(proc)}
+
+    s = recs["router_aggregate_speedup"]
+    assert {
+        "value", "unit", "replicas", "req_per_sec_router",
+        "req_per_sec_single_direct", "host_cores", "gate_enforced",
+    } <= s.keys(), s
+    assert s["replicas"] == 2 and s["unit"] == "x"
+    assert s["req_per_sec_router"] > 0
+    assert s["req_per_sec_single_direct"] > 0
+    assert not s.get("errors"), s
+    # the scaling claim gates only where two processes can actually run
+    # in parallel; the record says which regime it measured
+    assert s["gate_enforced"] == (s["host_cores"] >= 2)
+    if s["gate_enforced"]:
+        assert s["value"] >= 1.7, s
+
+    o = recs["router_latency_overhead"]
+    assert {
+        "value", "unit", "p50_direct_ms", "p95_direct_ms", "p50_router_ms",
+        "p95_router_ms", "samples", "byte_identical",
+    } <= o.keys(), o
+    assert o["byte_identical"] is True
+    assert o["value"] <= 10.0, o
+
+
 def test_elastic_bench_schema(tmp_home):
     proc = _run("benchmarks/elastic_bench.py", "--smoke")
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
