@@ -13,6 +13,17 @@ clock.py). Invariants — quotas never exceeded at any instant, gang
 reservations all-or-nothing and non-overlapping — are asserted at EVERY
 simulation event, so this doubles as a property check on real scheduler
 code, not a toy model of it.
+
+--watch-bench (PR 11) replays the workload to POPULATE the store, then
+races the two agent idle loops against each other on the resulting
+state: the pre-event-log loop (one `list_runs()` directory scan per
+iteration, O(runs)) vs the cursor loop (`wait_events(cursor, timeout=0)`,
+O(new events) — O(1) when idle). The report carries the measured
+speedup (gate: >= 10x at 10k runs) and `no_dir_scans: true`, asserted
+from the store's own scan counter staying flat across the watch phase.
+
+  python benchmarks/scheduler_bench.py --watch-bench --jobs 10000 \
+      --topology 16x16
 """
 
 from __future__ import annotations
@@ -58,6 +69,75 @@ def run_bench(
     return report
 
 
+def run_watch_bench(
+    seed: int,
+    n_jobs: int,
+    topology: str,
+    *,
+    window_s: float = 1.0,
+    min_speedup: float = 10.0,
+) -> dict:
+    """Populate the store via the simulator, then measure both agent idle
+    loops on the SAME populated store. Timing uses perf_counter directly:
+    benchmarks own their methodology (see scripts/lint_telemetry.py)."""
+    import time
+
+    jobs = synthetic_workload(seed, n_jobs, topology=topology)
+    # durable_store=False: fsync throttles POPULATION only — both measured
+    # loops are read-side and identical under either setting
+    sim = FleetSimulator(jobs, topology=topology, durable_store=False)
+    try:
+        sim.run()
+        store = sim.store
+        n_runs = len(store.list_runs())
+
+        # baseline: the pre-PR-11 agent idle loop — a full O(runs)
+        # directory scan per wakeup
+        t0 = time.perf_counter()
+        polls = 0
+        while time.perf_counter() - t0 < window_s:
+            store.list_runs()
+            polls += 1
+        poll_rate = polls / (time.perf_counter() - t0)
+
+        # cursor loop: drain the committed history once, then steady-state
+        # — each iteration asks "anything after my cursor?" and touches
+        # only the index tail, never a run directory
+        history = 0
+        cursor = "0:0"
+        while True:
+            batch, cursor = store.read_events_since(cursor, limit=10000)
+            history += len(batch)
+            if len(batch) < 10000:
+                break
+        scans_before = store.scans
+        t0 = time.perf_counter()
+        waits = 0
+        while time.perf_counter() - t0 < window_s:
+            _, cursor = store.wait_events(cursor, timeout=0)
+            waits += 1
+        watch_rate = waits / (time.perf_counter() - t0)
+        no_dir_scans = store.scans == scans_before
+    finally:
+        shutil.rmtree(sim.home, ignore_errors=True)
+
+    speedup = watch_rate / poll_rate if poll_rate else float("inf")
+    return {
+        "mode": "watch-bench",
+        "seed": seed,
+        "topology": topology,
+        "jobs": n_jobs,
+        "runs": n_runs,
+        "history_events": history,
+        "poll_iters_per_s": round(poll_rate, 1),
+        "watch_iters_per_s": round(watch_rate, 1),
+        "speedup": round(speedup, 1),
+        "min_speedup": min_speedup,
+        "no_dir_scans": no_dir_scans,
+        "ok": bool(no_dir_scans and speedup >= min_speedup),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seed", type=int, default=0)
@@ -73,9 +153,35 @@ def main(argv=None) -> int:
         action="store_true",
         help="skip per-event invariant assertions (pure timing)",
     )
+    p.add_argument(
+        "--watch-bench",
+        action="store_true",
+        help="event-log agent-loop throughput: cursor waits vs O(runs) "
+        "polling on the populated store (gate: >=10x, zero dir scans)",
+    )
     args = p.parse_args(argv)
     if args.smoke:
         args.jobs = min(args.jobs, 40)
+    if args.watch_bench:
+        report = run_watch_bench(
+            args.seed,
+            args.jobs,
+            args.topology,
+            window_s=0.2 if args.smoke else 1.0,
+            # tiny smoke stores scan fast enough that the ratio is noise;
+            # the full 10k gate keeps the real bar
+            min_speedup=2.0 if args.smoke else 10.0,
+        )
+        print(json.dumps(report, sort_keys=True))
+        if not report["ok"]:
+            print(
+                f"FAIL: watch speedup {report['speedup']}x "
+                f"(need >= {report['min_speedup']}x) "
+                f"no_dir_scans={report['no_dir_scans']}",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     report = run_bench(
         args.seed, args.jobs, args.topology, check_every_event=not args.no_check
     )

@@ -105,7 +105,49 @@ def _perform(fault: Fault, point: str, ctx: dict) -> None:
             mgr.wait_until_finished()
         corrupt_checkpoint(ctx["directory"], step=ctx.get("step"))
         return
+    if fault.action == "scramble_tail":
+        # a crash mid-append as the DISK sees it: some garbage bytes made
+        # it into the segment, then the process died. Recovery must
+        # truncate exactly back to the last whole frame.
+        scramble_tail(ctx["path"], _active.rng("scramble_tail"))
+        raise SimulatedKill(fault.message)
+    if fault.action == "corrupt_segment":
+        # bit rot inside an already-committed frame (no crash): later
+        # recovery must QUARANTINE the segment, never wedge a reader
+        corrupt_segment_frame(ctx["path"])
+        return
     raise ValueError(f"unknown chaos action {fault.action!r}")
+
+
+def scramble_tail(path: str, rng) -> int:
+    """Append 5-40 seeded garbage bytes to a log segment — the torn tail a
+    power cut leaves. Returns the number of bytes appended."""
+    n = rng.randrange(5, 40)
+    garbage = bytes(rng.randrange(256) for _ in range(n))
+    with open(path, "ab") as f:
+        f.write(garbage)
+    return n
+
+
+def corrupt_segment_frame(path: str) -> None:
+    """Flip one payload byte of the FIRST frame in a framed segment (CRC
+    now mismatches with valid data after it → the 'corrupt' verdict, not
+    'torn'). No-op on segments without a whole first frame."""
+    import struct
+
+    header = struct.Struct("<II")
+    p = Path(path)
+    try:
+        data = bytearray(p.read_bytes())
+    except OSError:
+        return
+    if len(data) < header.size:
+        return
+    length, _ = header.unpack_from(data, 0)
+    if length <= 0 or header.size + length > len(data):
+        return
+    data[header.size] ^= 0xFF
+    p.write_bytes(bytes(data))
 
 
 def corrupt_checkpoint(directory: str, step: Optional[int] = None) -> int:

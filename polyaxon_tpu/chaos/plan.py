@@ -275,3 +275,72 @@ class FaultPlan:
             params={"slow_start": start, "slow_len": slow,
                     "delay_ms": delay_ms},
         )
+
+    # ------------------------------------------- event-log store scenarios
+    # The store points (ISSUE 11): `store.append` fires right before a
+    # batch's frames hit the run's live segment (ctx: run, seq, path),
+    # `store.append.indexed` right after the global index append is
+    # durable, `store.compact` between the snapshot tmp fsync and its
+    # atomic swap, and `store.compact.swapped` between the swap and the
+    # old segments' deletion. A "kill" at any of them is a writer dying
+    # mid-protocol; recovery must keep every COMMITTED record.
+
+    @classmethod
+    def kill_mid_append(cls, seed: int, window: int) -> "FaultPlan":
+        """The store writer dies on a seed-chosen append, either before
+        the frames land (nothing of the batch committed) or after the
+        index fsync (everything committed, ack lost) — the two halves of
+        the commit protocol. Either way no committed record may vanish."""
+        rng = random.Random(f"kill_mid_append:{seed}")
+        point = rng.choice(["store.append", "store.append.indexed"])
+        k = rng.randrange(0, window)
+        return cls(
+            [Fault(point, "kill",
+                   at=k, message=f"chaos: writer killed at {point} #{k}")],
+            seed=seed,
+            params={"kill_point": point, "kill_hit": k},
+        )
+
+    @classmethod
+    def kill_mid_compaction(cls, seed: int) -> "FaultPlan":
+        """The writer dies inside compaction: seed-chosen between 'snapshot
+        written but not swapped' (stray tmp, segments intact) and 'swapped
+        but old segments not deleted' (replay must dedupe on seq). Both
+        windows must replay byte-identical history."""
+        rng = random.Random(f"kill_mid_compaction:{seed}")
+        point = rng.choice(["store.compact", "store.compact.swapped"])
+        return cls(
+            [Fault(point, "kill",
+                   message=f"chaos: writer killed at {point}")],
+            seed=seed,
+            params={"kill_point": point},
+        )
+
+    @classmethod
+    def scrambled_tail(cls, seed: int, window: int) -> "FaultPlan":
+        """A power-cut-shaped death: seeded garbage bytes land on the live
+        segment's tail, THEN the writer dies, on a seed-chosen append.
+        Recovery must truncate back to the last whole frame (counted in
+        store_recovered_tails_total) and lose only the unacked batch."""
+        rng = random.Random(f"scrambled_tail:{seed}")
+        k = rng.randrange(0, window)
+        return cls(
+            [Fault("store.append", "scramble_tail",
+                   at=k, message=f"chaos: torn tail at append #{k}")],
+            seed=seed,
+            params={"scramble_hit": k},
+        )
+
+    @classmethod
+    def corrupt_segment(cls, seed: int, window: int) -> "FaultPlan":
+        """Bit rot: one committed payload byte flips (no crash) before a
+        seed-chosen append. The next recovery must quarantine the segment
+        to <seg>.corrupt — and keep serving reads, never wedge."""
+        rng = random.Random(f"corrupt_segment:{seed}")
+        k = rng.randrange(0, window)
+        return cls(
+            [Fault("store.append", "corrupt_segment",
+                   at=k, message=f"chaos: bit rot before append #{k}")],
+            seed=seed,
+            params={"corrupt_hit": k},
+        )

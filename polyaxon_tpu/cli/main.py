@@ -1332,6 +1332,85 @@ def admin_teardown(namespace, keep_store):
         )
 
 
+@cli.command()
+@click.argument("ref")
+@click.option("--follow/--no-follow", default=False,
+              help="keep tailing the run's event log over the watch cursor")
+@click.option("--timeout", default=0.5, type=float, show_default=True,
+              help="per-wait long-poll bound while following")
+def events(ref, follow, timeout):
+    """Run history straight from the event log, one JSON record per line.
+
+    With --follow, rides the store's watch cursor: replays the committed
+    history, then blocks on commits (no sleep-polling, no directory
+    scans) until the run reaches a terminal status.
+    """
+    from ..schemas.lifecycle import DONE_STATUSES
+    from ..store.local import UnknownRunError
+
+    store = RunStore()
+    try:
+        uid = store.resolve(ref)
+    except UnknownRunError as e:
+        raise click.ClickException(str(e.args[0]) if e.args else str(e))
+    if not follow:
+        for rec in store.get_history(uid):
+            click.echo(json.dumps(rec, default=str))
+        return
+    store.get_history(uid)  # force legacy import so the log has the run
+
+    def _terminal() -> bool:
+        try:
+            return V1Statuses(
+                store.get_status(uid).get("status", "")
+            ) in DONE_STATUSES
+        except ValueError:
+            return False
+
+    # cursor "0:0" = full history first; `stop` is checked after each
+    # wait round, so the terminal record itself is always emitted
+    for rec in store.watch("0:0", timeout=timeout, stop=_terminal):
+        if rec.get("r") == uid:
+            click.echo(json.dumps(rec, default=str))
+
+
+@cli.group("store")
+def store_cmd():
+    """Run-store maintenance: event-log migration and recovery."""
+
+
+@store_cmd.command("migrate")
+def store_migrate():
+    """Import legacy per-run JSON dirs into the event log and stamp the
+    layout version. Idempotent — safe to re-run any time."""
+    store = RunStore()
+    before = store.store_format()
+    n = store.migrate()
+    click.echo(
+        f"migrated {n} run(s); store format {before} -> {store.store_format()}"
+    )
+
+
+@store_cmd.command("recover")
+@click.option("-uid", "--uid", default=None,
+              help="one run only (default: the whole store)")
+def store_recover(uid):
+    """Heal interrupted appends, truncate torn tails, quarantine corrupt
+    segments, and refresh the status views."""
+    store = RunStore()
+    if uid is not None:
+        from ..store.local import UnknownRunError
+
+        try:
+            store.recover(store.resolve(uid))
+        except UnknownRunError as e:
+            raise click.ClickException(str(e.args[0]) if e.args else str(e))
+        click.echo(f"recovered {uid}")
+        return
+    n = store.recover()
+    click.echo(f"recovered {n} run(s)")
+
+
 def main():
     # `POLYAXON_JAX_PLATFORM=cpu POLYAXON_NUM_CPU_DEVICES=8 polyaxon run ...`
     # drives a virtual 8-device slice on a laptop/CI box

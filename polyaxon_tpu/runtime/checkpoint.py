@@ -247,12 +247,16 @@ class CheckpointTiers:
         return self.local or self.durable
 
     def save(self, step: int, state, *, wait: bool = False) -> None:
-        self._raise_pending()
+        # land the local save BEFORE surfacing a stashed upload death:
+        # raising first would lose this boundary too, and the documented
+        # bound is "at most the steps since the last boundary". The
+        # restart then resumes from the step just saved.
         save_checkpoint(self.primary, step, state, keep=self.keep)
         _tier_counter(
             "checkpoint.tier_writes",
             "Checkpoint step landings, all tiers (local save + durable upload)",
         ).inc()
+        self._raise_pending()
         if self.local:
             self._ensure_worker()
             self._queue.put(step)

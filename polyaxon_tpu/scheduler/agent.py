@@ -14,7 +14,6 @@ processes until the queue is empty — used by tests and one-shot CLIs.
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Optional
 
 from ..compiler.resolver import CompiledOperation, compile_operation
@@ -305,7 +304,11 @@ class Agent:
 
     def serve(self, poll_interval: float = 1.0, stop_when=lambda: False):
         """Long-running loop: fire due schedules, reconcile cluster state
-        (when this agent submits to a cluster), poll the queues, repeat."""
+        (when this agent submits to a cluster), drain the queues, then
+        block on the store's event cursor until something changes (or
+        `poll_interval` elapses — schedules still need a heartbeat).
+        Event-driven since PR 11: an idle agent costs O(1) per wakeup
+        instead of an O(runs) listing per poll."""
         from .schedules import ScheduleRegistry
 
         registry = ScheduleRegistry(self.store)
@@ -323,6 +326,12 @@ class Agent:
             if scope is None and self._pinned:
                 scope = [self.queue.name]
             reconciler = Reconciler(self.store, self.cluster, queues=scope)
+        # heal any interrupted batch from a previous writer before serving
+        try:
+            self.store.recover()
+        except Exception as e:  # noqa: BLE001 — recovery is best-effort here
+            print(f"store recovery error: {e}")
+        cursor = self.store.head_cursor()
         while not stop_when():
             try:
                 registry.tick(self)
@@ -337,4 +346,6 @@ class Agent:
             # concurrency batches form (a max_runs=1 budget would clamp
             # every batch to size 1 and silently disable the feature)
             if self.drain() == 0:
-                time.sleep(poll_interval)
+                # idle: block on the event log instead of sleeping blind —
+                # a submit on another thread/process wakes us immediately
+                _, cursor = self.store.wait_events(cursor, timeout=poll_interval)

@@ -247,14 +247,19 @@ class ReservationLedger:
         self._lock_path = self.dir / "reservations.lock"
 
     def _locked(self, fn):
+        """Run `fn(data) -> (result, new_data_or_None)` under the ledger
+        lock. `None` for new_data means "unchanged" and skips the
+        rewrite — admission probes a reservation attempt for every queued
+        entry, and a failed placement must not pay a full-state write."""
         with open(self._lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
             try:
                 data = self._read()
-                result, data = fn(data)
-                tmp = self.path.with_suffix(".json.tmp")
-                tmp.write_text(json.dumps(data, indent=1))
-                os.replace(tmp, self.path)
+                result, new_data = fn(data)
+                if new_data is not None:
+                    tmp = self.path.with_suffix(".json.tmp")
+                    tmp.write_text(json.dumps(new_data, indent=1))
+                    os.replace(tmp, self.path)
                 return result
             finally:
                 fcntl.flock(lock, fcntl.LOCK_UN)
@@ -280,7 +285,9 @@ class ReservationLedger:
 
     def remove(self, run_uuid: str) -> Optional[dict]:
         def fn(data):
-            return data.pop(run_uuid, None), data
+            if run_uuid not in data:
+                return None, None
+            return data.pop(run_uuid), data
 
         return self._locked(fn)
 
@@ -371,11 +378,11 @@ class Fleet:
 
         def fn(data):
             if run_uuid in data:
-                return data[run_uuid], data
+                return data[run_uuid], None
             used = {tuple(c) for rec in data.values() for c in rec["coords"]}
             coords = inv.place(chips, used, block=block)
             if coords is None:
-                return None, data
+                return None, None
             record = {
                 "uuid": run_uuid,
                 "chips": chips,

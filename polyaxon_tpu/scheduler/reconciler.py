@@ -129,6 +129,12 @@ class Reconciler:
         self._last_err: dict[str, str] = {}
         # consecutive client-fault count per run (the error budget meter)
         self._errs: dict[str, int] = {}
+        # cursor-driven working set: runs the event log has shown in an
+        # active state. Replaces the per-tick O(runs) list_runs() scan —
+        # the first tick replays the index once, later ticks are
+        # O(tracked active runs + new events).
+        self._tracked: set[str] = set()
+        self._cursor: Optional[str] = None
 
     def _owns(self, uuid: str, status: dict) -> bool:
         """Ownership key: the ROUTED queue recorded in run meta at submit
@@ -173,6 +179,37 @@ class Reconciler:
                 self.store.set_status(run_uuid, s, reason=reason)
 
     # --------------------------------------------------------------- tick
+    def _ingest(self) -> None:
+        """Advance the watch cursor and fold newly-active runs into the
+        working set. The first call replays the whole index (one pass,
+        startup only); steady-state calls read only events committed
+        since the previous tick — no directory scans."""
+        cursor = self._cursor if self._cursor is not None else "0:0"
+        seen: dict[str, str] = {}
+        while True:
+            events, cursor = self.store.read_events_since(cursor, limit=5000)
+            for ev in events:
+                uuid = ev.get("r")
+                if not uuid:
+                    continue
+                kind = ev.get("kind")
+                if kind == "status":
+                    seen[uuid] = ev.get("status")
+                elif kind == "create":
+                    seen[uuid] = (ev.get("cond") or {}).get("type")
+            if len(events) < 5000:
+                break
+        self._cursor = cursor
+        for uuid, status in seen.items():
+            try:
+                active = V1Statuses(status) in _ACTIVE or (
+                    V1Statuses(status) == V1Statuses.STOPPING
+                )
+            except (ValueError, TypeError):
+                active = True  # unclassifiable: let _tick_one decide
+            if active:
+                self._tracked.add(uuid)
+
     def tick(self) -> list[tuple[str, str]]:
         """One reconcile pass over every active cluster-submitted run.
         Returns [(uuid, new_status)] for runs whose status changed.
@@ -181,9 +218,9 @@ class Reconciler:
         kubectl error, malformed response) on ONE run must not stop the
         other gangs from draining — the run keeps its current status, the
         error lands in its log, and the next tick retries."""
+        self._ingest()
         changes = []
-        for rec in self.store.list_runs():
-            uuid = rec["uuid"]
+        for uuid in sorted(self._tracked):
             try:
                 change = self._tick_one(uuid)
                 self._last_err.pop(uuid, None)
@@ -202,7 +239,26 @@ class Reconciler:
                 continue
             if change is not None:
                 changes.append(change)
+            self._retire(uuid)
         return changes
+
+    def _retire(self, uuid: str) -> None:
+        """Drop a run from the working set once it no longer needs ticks:
+        terminal, deleted, or not (yet) a cluster run. A later lifecycle
+        event re-adds it through `_ingest` — nothing is lost, the set just
+        stays O(active cluster runs)."""
+        try:
+            status = self.store.get_status(uuid).get("status")
+            if status:
+                current = V1Statuses(status)
+                if current in _ACTIVE or current == V1Statuses.STOPPING:
+                    if (self.store.run_dir(uuid) / "manifests.json").exists():
+                        return  # still this reconciler's business
+        except (ValueError, OSError):
+            return  # can't classify: keep it, next tick retries
+        self._tracked.discard(uuid)
+        self._errs.pop(uuid, None)
+        self._last_err.pop(uuid, None)
 
     def _burn_error_budget(self, uuid: str, msg: str) -> Optional[tuple[str, str]]:
         """Count a consecutive client fault against the run's error budget;
@@ -309,15 +365,18 @@ class Reconciler:
         return (uuid, self.store.get_status(uuid)["status"])
 
     def watch(self, poll_interval: float = 2.0, stop_when=lambda: False):
-        import time
-
+        """Tick until every tracked cluster run settles. Cursor-driven:
+        between ticks it blocks on the event log (woken by any commit)
+        instead of sleeping blind, and the settled check walks the O(active)
+        working set, not the whole store."""
         while not stop_when():
             self.tick()
-            if all(
-                V1Statuses(self.store.get_status(r["uuid"]).get("status"))
-                in DONE_STATUSES
-                for r in self.store.list_runs()
-                if (self.store.run_dir(r["uuid"]) / "manifests.json").exists()
+            if not any(
+                V1Statuses(self.store.get_status(u).get("status", "unknown"))
+                not in DONE_STATUSES
+                and (self.store.run_dir(u) / "manifests.json").exists()
+                for u in self._tracked
             ):
                 return
-            time.sleep(poll_interval)
+            # don't advance the cursor here: the next tick's _ingest owns it
+            self.store.wait_events(self._cursor, timeout=poll_interval)

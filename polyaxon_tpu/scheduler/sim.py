@@ -78,12 +78,16 @@ class FleetSimulator:
         quotas: Optional[list] = None,
         home=None,
         invariant_fn=None,
+        durable_store: bool = True,
     ):
         import tempfile
 
         self.clock = SimClock()
         self.home = home or tempfile.mkdtemp(prefix="polyaxon-sim-")
-        self.store = RunStore(self.home)
+        # durable_store=False skips the event log's fsyncs: benchmark
+        # population of 10k-run workloads is IO-bound on fsync, and the
+        # scheduling decisions under test are identical either way
+        self.store = RunStore(self.home, eventlog_fsync=durable_store)
         self.fleet = Fleet(self.store, clock=self.clock)
         self.fleet.configure(topology=topology, chips=chips)
         self.quotas = QuotaManager(self.store)

@@ -4,19 +4,34 @@ control-plane DB + artifact store (SURVEY.md §2 "Control plane (haupt)" /
 
 Layout under $POLYAXON_HOME (default ~/.polyaxon):
   runs/<uuid>/spec.json      compiled operation (concrete, post-interpolation)
-  runs/<uuid>/status.json    lifecycle status + condition history
+  runs/<uuid>/status.json    MATERIALIZED VIEW of the run's event log
+  runs/<uuid>/log/           the run's event log (see store/eventlog.py)
   runs/<uuid>/metrics.jsonl  one JSON line per logged step
   runs/<uuid>/events.jsonl   non-metric tracked events (artifacts refs, ...)
   runs/<uuid>/logs.txt       captured run logs
   runs/<uuid>/outputs/       artifacts root (checkpoints/, profiler/, ...)
   index.jsonl                append-only run registry
+  eventlog/                  global event index + watch cursors
+  store_format               layout version stamp ("2" = event-log store)
 
-Writes are single-writer-per-run and append-only where possible, so a
-sidecar/streams service can tail them without coordination.
+Since PR 11 the ordering authority for every lifecycle mutation is the
+append-only event log (`store/eventlog.py`): status transitions, meta
+merges, and tracked events commit there first (fsync'd group commit,
+single-writer lease per run), and `status.json` is just a view the log
+writes back for cheap polling — `get_status` never takes a lock. This
+closes the old read-modify-write window in `set_status`: two concurrent
+terminal transitions now serialize on the run's lease and exactly one
+wins. Legacy dirs (pre-event-log) are migrated into the log on first
+write (`_ensure_migrated`) or in bulk via `migrate()`.
+
+Consumers should prefer the cursor API (`head_cursor` /
+`read_events_since` / `wait_events` / `watch`) over `list_runs()`
+polling: a cursor read is O(new events), a listing is O(runs).
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -45,11 +60,98 @@ def polyaxon_home() -> Path:
     return Path(_get_setting("home"))
 
 
+STORE_FORMAT = "2"
+
+
 class RunStore:
-    def __init__(self, home: Optional[Path | str] = None):
+    def __init__(
+        self,
+        home: Optional[Path | str] = None,
+        *,
+        eventlog_fsync: Optional[bool] = None,
+    ):
         self.home = Path(home) if home else polyaxon_home()
         self.runs_dir = self.home / "runs"
         self.runs_dir.mkdir(parents=True, exist_ok=True)
+        # a store with no pre-event-log runs to import was never format 1:
+        # stamp it so `store migrate` on a fresh home is a visible no-op
+        stamp = self.home / "store_format"
+        if not stamp.exists() and not (self.home / "index.jsonl").exists():
+            with contextlib.suppress(OSError):
+                stamp.write_text(STORE_FORMAT + "\n")
+        self._eventlog = None
+        self._eventlog_fsync = eventlog_fsync
+        # O(runs) listing counter: the scheduler-bench no-directory-scan
+        # assertion pins this to zero growth in steady state
+        self.scans = 0
+
+    # ----------------------------------------------------------- event log
+    @property
+    def eventlog(self):
+        """The store's ordering authority (lazy: pure-read stores that
+        never touch lifecycle state pay nothing)."""
+        if self._eventlog is None:
+            from ..telemetry import now as _mono
+            from .eventlog import EventLog
+
+            self._eventlog = EventLog(
+                self.home,
+                wall=time.time,
+                mono=_mono,
+                fsync=self._eventlog_fsync,
+                view_writer=self._write_view,
+            )
+        return self._eventlog
+
+    def _write_view(self, run_uuid: str, doc: dict) -> None:
+        """status.json is a non-durable materialized view: atomic replace
+        so readers never see a torn file, but no fsync — on crash the log
+        is the truth and `recover()` refreshes the view."""
+        run_dir = self.run_dir(run_uuid)
+        run_dir.mkdir(parents=True, exist_ok=True)
+        path = run_dir / "status.json"
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(doc, indent=1, default=str))
+        os.replace(tmp, path)
+
+    def _ensure_migrated(
+        self, run_uuid: str, *, name: str = "", project: str = ""
+    ) -> bool:
+        """Import a legacy (pre-event-log) run dir into the log on first
+        touch. No-op for runs already in the log or brand-new runs."""
+        log = self.eventlog
+        if log.has_run(run_uuid):
+            return False
+        doc = _read_json(self.run_dir(run_uuid) / "status.json")
+        if not doc or not doc.get("status"):
+            return False
+        events = _read_jsonl(self.run_dir(run_uuid) / "events.jsonl")
+        log.import_legacy(
+            run_uuid, doc, events, name=name, project=project
+        )
+        return True
+
+    def migrate(self) -> int:
+        """Bulk-import every legacy run dir into the event log and stamp
+        the layout version. Idempotent. Returns the number migrated."""
+        n = 0
+        for rec in _read_jsonl(self.home / "index.jsonl"):
+            if self._ensure_migrated(
+                rec["uuid"],
+                name=rec.get("name", ""),
+                project=rec.get("project", ""),
+            ):
+                n += 1
+        self.eventlog.recover_all()
+        (self.home / "store_format").write_text(STORE_FORMAT + "\n")
+        return n
+
+    def store_format(self) -> str:
+        path = self.home / "store_format"
+        try:
+            return path.read_text().strip()
+        except OSError:
+            return "1"
 
     # ----------------------------------------------------------- creation
     def create_run(
@@ -63,20 +165,23 @@ class RunStore:
         meta: Optional[dict] = None,
     ) -> Path:
         run_dir = self.run_dir(run_uuid)
-        if (run_dir / "status.json").exists():
+        if (run_dir / "status.json").exists() or self.eventlog.has_run(
+            run_uuid
+        ):
             # idempotent: agent-submitted runs are created at queue time and
             # hit the executor's create_run again at execution time
             return run_dir
         run_dir.mkdir(parents=True, exist_ok=True)
         (run_dir / "outputs").mkdir(exist_ok=True)
         _write_json(run_dir / "spec.json", spec)
-        _write_json(
-            run_dir / "status.json",
+        self.eventlog.append(
+            run_uuid,
+            "create",
             {
-                "uuid": run_uuid,
-                "status": V1Statuses.CREATED,
-                "conditions": [_condition(V1Statuses.CREATED)],
+                "cond": _condition(V1Statuses.CREATED),
                 "meta": meta or {},
+                "name": name,
+                "project": project,
             },
         )
         with self._index_lock(), (self.home / "index.jsonl").open("a") as f:
@@ -104,14 +209,27 @@ class RunStore:
     def set_status(
         self, run_uuid: str, status: str, reason: str = "", message: str = ""
     ):
-        path = self.run_dir(run_uuid) / "status.json"
-        data = _read_json(path) or {"uuid": run_uuid, "conditions": []}
-        current = data.get("status")
-        if current and not can_transition(V1Statuses(current), V1Statuses(status)):
-            raise ValueError(f"illegal status transition {current} → {status}")
-        data["status"] = status
-        data["conditions"].append(_condition(status, reason, message))
-        _write_json(path, data)
+        self._ensure_migrated(run_uuid)
+
+        def _validate(doc: dict) -> None:
+            current = doc.get("status")
+            if current and not can_transition(
+                V1Statuses(current), V1Statuses(status)
+            ):
+                raise ValueError(
+                    f"illegal status transition {current} → {status}"
+                )
+
+        # the event log is the single ordering authority: validation runs
+        # under the run's writer lease against the log-derived document,
+        # so two racing transitions serialize and exactly one commits —
+        # the old status.json read-modify-write lost-update window is gone
+        self.eventlog.append(
+            run_uuid,
+            "status",
+            {"status": status, "cond": _condition(status, reason, message)},
+            validate=_validate,
+        )
         # the single transition choke point: every lifecycle move in this
         # process lands in the global registry (scraped at /metricsz)
         from ..telemetry import get_registry
@@ -141,6 +259,43 @@ class RunStore:
 
     def get_status(self, run_uuid: str) -> dict:
         return _read_json(self.run_dir(run_uuid) / "status.json") or {}
+
+    def get_history(self, run_uuid: str) -> list[dict]:
+        """The run's committed event-log records in sequence order — the
+        byte-identical replay source chaos recovery is pinned against."""
+        self._ensure_migrated(run_uuid)
+        return self.eventlog.history(run_uuid)
+
+    def recover(self, run_uuid: Optional[str] = None):
+        """Crash recovery: heal interrupted batches, truncate torn tails,
+        quarantine corrupt segments, refresh status.json views. One run,
+        or the whole store when `run_uuid` is None."""
+        if run_uuid is not None:
+            return self.eventlog.recover_run(run_uuid)
+        return self.eventlog.recover_all()
+
+    def compact_run(self, run_uuid: str) -> None:
+        self._ensure_migrated(run_uuid)
+        self.eventlog.compact(run_uuid)
+
+    # ----------------------------------------------------------- cursors
+    def head_cursor(self) -> str:
+        return self.eventlog.head_cursor()
+
+    def read_events_since(
+        self, cursor: Optional[str] = None, limit: int = 10000
+    ) -> tuple[list[dict], str]:
+        return self.eventlog.read_since(cursor, limit)
+
+    def wait_events(
+        self, cursor: Optional[str] = None, timeout: float = 1.0
+    ) -> tuple[list[dict], str]:
+        """Long-poll for committed events after `cursor` (from "now" when
+        None). O(new events), never O(runs)."""
+        return self.eventlog.wait(cursor, timeout=timeout)
+
+    def watch(self, cursor: Optional[str] = None, **kw) -> Iterator[dict]:
+        return self.eventlog.watch(cursor, **kw)
 
     def _index_lock(self):
         """Cross-process lock serializing index.jsonl appends and rewrites.
@@ -228,6 +383,7 @@ class RunStore:
         run_dir = self.run_dir(run_uuid)
         if run_dir.exists():
             shutil.rmtree(run_dir)  # errors propagate: index stays intact
+        self.eventlog.forget(run_uuid)
         index = self.home / "index.jsonl"
         if index.exists():
             # under the shared index lock (held by create_run's append too)
@@ -245,12 +401,10 @@ class RunStore:
 
     def set_meta(self, run_uuid: str, **entries):
         """Merge keys into the run's status meta (attempt counters etc.)."""
-        path = self.run_dir(run_uuid) / "status.json"
-        data = _read_json(path)
-        if data is None:
-            raise KeyError(f"unknown run {run_uuid}")
-        data.setdefault("meta", {}).update(entries)
-        _write_json(path, data)
+        self._ensure_migrated(run_uuid)
+        self.eventlog.append(
+            run_uuid, "meta", {"entries": entries}, must_exist=True
+        )
 
     def request_stop(self, run_uuid: str) -> str:
         """Lifecycle-aware stop: RUNNING goes to STOPPING and stays there —
@@ -276,13 +430,23 @@ class RunStore:
             f.write(line + "\n")
 
     def log_event(self, run_uuid: str, kind: str, body: dict[str, Any]):
-        line = json.dumps({"kind": kind, "ts": time.time(), **body})
+        # migrate BEFORE the jsonl append so the new row isn't imported
+        # twice; the legacy file write stays FIRST among writes so a
+        # missing run dir still fails the old way (FileNotFoundError)
+        self._ensure_migrated(run_uuid)
+        line = {"kind": kind, "ts": time.time(), **body}
         with (self.run_dir(run_uuid) / "events.jsonl").open("a") as f:
-            f.write(line + "\n")
+            f.write(json.dumps(line) + "\n")
+        self.eventlog.append(run_uuid, "event", {"event": line})
 
     def append_log(self, run_uuid: str, text: str):
         with (self.run_dir(run_uuid) / "logs.txt").open("a") as f:
             f.write(text if text.endswith("\n") else text + "\n")
+        # a non-durable pulse: wakes watch cursors (live log tailing)
+        # without paying an fsync per log line
+        self.eventlog.append(
+            run_uuid, "log", {"n": len(text)}, durable=False
+        )
 
     # ----------------------------------------------------------- reads
     def read_metrics(self, run_uuid: str) -> list[dict]:
@@ -299,6 +463,7 @@ class RunStore:
         return _read_json(self.run_dir(run_uuid) / "spec.json") or {}
 
     def list_runs(self, project: Optional[str] = None) -> list[dict]:
+        self.scans += 1
         out = []
         for rec in _read_jsonl(self.home / "index.jsonl"):
             if project and rec.get("project") != project:
@@ -329,9 +494,12 @@ class RunStore:
         raise UnknownRunError(f"no run matching {ref!r}")
 
     def watch_logs(self, run_uuid: str, poll: float = 0.3) -> Iterator[str]:
-        """Tail logs until the run reaches a terminal status."""
+        """Tail logs until the run reaches a terminal status. Cursor-driven
+        since PR 11: between reads we block on the event log (woken by the
+        run's non-durable log pulses) instead of sleeping blind."""
         path = self.run_dir(run_uuid) / "logs.txt"
         pos = 0
+        cursor = self.eventlog.head_cursor()
         while True:
             if path.exists():
                 with path.open() as f:
@@ -346,7 +514,7 @@ class RunStore:
                     break
             except ValueError:
                 pass
-            time.sleep(poll)
+            _, cursor = self.eventlog.wait(cursor, timeout=poll)
 
 
 def _condition(status: str, reason: str = "", message: str = "") -> dict:

@@ -150,13 +150,31 @@ def spec() -> dict:
             },
             "/runs": {
                 "get": {
-                    "summary": "List runs",
+                    "summary": "List runs, or long-poll the event log "
+                    "(?watch=<cursor>)",
                     "parameters": [
                         {
                             "name": "project",
                             "in": "query",
                             "schema": {"type": "string"},
-                        }
+                        },
+                        {
+                            "name": "watch",
+                            "in": "query",
+                            "description": "Event-log cursor (seq:offset). "
+                            "Empty or 'now' starts from the present. The "
+                            "response is {events, cursor}; pass the "
+                            "returned cursor back to resume with no gaps "
+                            "or duplicates across server restarts.",
+                            "schema": {"type": "string"},
+                        },
+                        {
+                            "name": "timeout",
+                            "in": "query",
+                            "description": "Long-poll bound in seconds "
+                            "(default 10, clamped to [0, 30]).",
+                            "schema": {"type": "number"},
+                        },
                     ],
                     "responses": {
                         "200": {

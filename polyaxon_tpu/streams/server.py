@@ -7,6 +7,9 @@ over the run store — the same files the trainer/sidecar write. Endpoints:
   GET  /healthz
   GET  /readyz
   GET  /runs                         → index (optionally ?project=)
+  GET  /runs?watch=<cursor>          → long-poll the store's event log;
+                                       returns {events, cursor}; bounded
+                                       by ?timeout= (default 10s, max 30)
   GET  /runs/<uuid>/status
   GET  /runs/<uuid>/logs[?offset=N]  → text; offset supports tail-follow
   GET  /runs/<uuid>/metrics
@@ -119,6 +122,25 @@ class _Handler(BaseHTTPRequestHandler):
 
                 return self._send(200, _json_bytes(Fleet(store).snapshot()))
             if parts == ["runs"]:
+                if "watch" in query:
+                    # long-poll on the store's event log: returns as soon
+                    # as events after the cursor commit, or after a bounded
+                    # timeout with an empty list + the resume cursor.
+                    # cursor "" or "now" = only events from this moment on.
+                    raw = query.get("watch", "")
+                    cursor = None if raw in ("", "now") else raw
+                    try:
+                        timeout = float(query.get("timeout", "10"))
+                    except (TypeError, ValueError):
+                        raise BadParam(
+                            "query param 'timeout' must be a number, got "
+                            f"{query.get('timeout')!r}"
+                        ) from None
+                    timeout = min(max(timeout, 0.0), 30.0)
+                    events, cur = store.wait_events(cursor, timeout=timeout)
+                    return self._send(
+                        200, _json_bytes({"events": events, "cursor": cur})
+                    )
                 return self._send(
                     200, _json_bytes(store.list_runs(query.get("project")))
                 )

@@ -76,6 +76,19 @@ replicas and flap the autoscaler. The router must take time ONLY from
 `telemetry.now()`, so any direct `time.*` / `datetime.now/utcnow/today`
 call in that file is forbidden.
 
+Ninth rule: NO raw clock in the event-log store. The run event log
+(`polyaxon_tpu/store/eventlog.py`) is the control plane's single
+ordering authority: replay, watch cursors, and crash recovery all order
+by monotonic sequence number, and the two timestamps it does emit
+(record `ts`, fsync latency) come from INJECTED callables (`wall=`,
+`mono=` passed by the store layer). A direct `time.*()` /
+`datetime.now()` read there would couple replay to the host clock —
+chaos tests could no longer replay byte-identical histories — and
+`time.sleep` would hide a missing commit-notification path. Any direct
+`time.time/monotonic/perf_counter/sleep` (and `_ns` variants) or
+`datetime.now/utcnow/today` call in that file is forbidden: order by
+sequence number, take clocks through the constructor.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -126,6 +139,13 @@ ROUTER_PATTERN = re.compile(
 ROUTER_MODULES = (
     ("polyaxon_tpu", "serving", "router.py"),
 )
+STORE_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+STORE_MODULES = (
+    ("polyaxon_tpu", "store", "eventlog.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -156,6 +176,7 @@ def violations(repo_root: Path) -> list[str]:
         in_ckpt = rel.parts in CKPT_MODULES
         in_spec = rel.parts in SPEC_MODULES
         in_router = rel.parts in ROUTER_MODULES
+        in_store = rel.parts in STORE_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -195,6 +216,12 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: raw clock in the serving router — "
                     f"balancing and autoscale burn must ride "
                     f"telemetry.now() only: {line.strip()}"
+                )
+            if in_store and STORE_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in the event-log store — "
+                    f"order by sequence number; clocks are injected "
+                    f"(wall=/mono= ctor args): {line.strip()}"
                 )
     return out
 

@@ -757,6 +757,167 @@ PY
       echo "ROUTER-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # event-log crash gate: a REAL run through the Agent/Fleet stack,
+    # then the store writer takes a real SIGKILL mid-append (seeded
+    # garbage lands on the live segment first — the torn tail a power
+    # cut leaves). A fresh process must recover ZERO lost committed
+    # transitions (byte-identical history), count the truncation in
+    # store_recovered_tails_total, and resume the pre-kill watch cursor
+    # with no gaps and no duplicates. Any lost transition FAILS.
+    echo "running event-log crash smoke $(date -u +%T)" >> "$log"
+    if ! timeout 900 python - >> "$log" 2>&1 <<'PY'
+import json
+import subprocess
+import sys
+import tempfile
+import threading
+import urllib.request
+
+sys.path.insert(0, ".")
+
+home = tempfile.mkdtemp(prefix="canary-eventlog-")
+
+# the child IS the store writer: it drives the run end-to-end through
+# the real Agent/Fleet stack, records what the log acknowledged, then
+# dies by real SIGKILL the instant the chaos plan tears its next append
+CHILD = r"""
+import json, os, signal, sys
+sys.path.insert(0, ".")
+home = sys.argv[1]
+from polyaxon_tpu.chaos.injector import SimulatedKill, active
+from polyaxon_tpu.chaos.plan import FaultPlan
+from polyaxon_tpu.scheduler.agent import Agent
+from polyaxon_tpu.scheduler.fleet import Fleet
+from polyaxon_tpu.schemas.operation import V1Operation
+from polyaxon_tpu.store import RunStore
+
+store = RunStore(home)
+Fleet(store).configure(chips=2)
+agent = Agent(store=store)
+op = V1Operation.model_validate({
+    "kind": "operation",
+    "name": "canary-eventlog",
+    "environment": {"resources": {"chips": 2}},
+    "component": {
+        "kind": "component",
+        "name": "c",
+        "termination": {"maxRetries": 0},
+        "run": {
+            "kind": "jaxjob",
+            "program": {
+                "model": {"name": "mlp", "config": {
+                    "input_dim": 8, "num_classes": 2, "hidden": [4]}},
+                "data": {"name": "synthetic", "batchSize": 8,
+                         "config": {"shape": [8], "num_classes": 2}},
+                "optimizer": {"name": "sgd", "learningRate": 0.01},
+                "train": {"steps": 3, "logEvery": 1,
+                          "precision": "float32"},
+            },
+        },
+    },
+})
+uid = agent.submit(op)
+agent.drain()
+status = store.get_status(uid)
+assert getattr(status["status"], "value", status["status"]) == "succeeded"
+# everything committed so far: append() returned, so this set is the
+# gate's "zero lost transitions" contract after the kill
+with open(os.path.join(home, "acked.json"), "w") as f:
+    json.dump({
+        "uuid": uid,
+        "history": store.get_history(uid),
+        "cursor": store.head_cursor(),
+    }, f, default=str)
+    f.flush()
+    os.fsync(f.fileno())
+plan = FaultPlan.scrambled_tail(seed=7, window=1)  # the NEXT append
+try:
+    with active(plan):
+        store.eventlog.append(uid, "event", {"event": {"torn": True}})
+except SimulatedKill:
+    os.kill(os.getpid(), signal.SIGKILL)  # page cache keeps the garbage
+print("eventlog child: scrambled-tail fault never fired")
+sys.exit(3)
+"""
+rc = subprocess.call([sys.executable, "-c", CHILD, home])
+if rc != -9:
+    print(f"eventlog smoke: child exited rc={rc}, expected SIGKILL (-9)")
+    sys.exit(1)
+with open(f"{home}/acked.json") as f:
+    acked = json.load(f)
+uid = acked["uuid"]
+
+from polyaxon_tpu.store import RunStore
+from polyaxon_tpu.streams.server import make_server
+from polyaxon_tpu.telemetry import get_registry
+
+store = RunStore(home)  # the restarted writer
+store.recover()
+tails = get_registry().counter("store.recovered_tails").value
+if tails < 1:
+    print("eventlog smoke: recovery truncated no torn tail", tails)
+    sys.exit(1)
+
+norm = lambda h: json.dumps(h, sort_keys=True, default=str)
+recovered = store.get_history(uid)
+if norm(recovered) != norm(acked["history"]):
+    print("eventlog smoke: committed history diverged after crash")
+    print(" acked:", norm(acked["history"])[:2000])
+    print(" recovered:", norm(recovered)[:2000])
+    sys.exit(1)
+
+# cursor integrity: the full replay is gap-free and duplicate-free, and
+# the child's pre-kill cursor resumes cleanly — the torn (unacked)
+# append must NOT appear, the first post-recovery commit must
+entries, _ = store.read_events_since("0:0")
+seqs = [e["seq"] for e in entries]
+if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+    print("eventlog smoke: replay has gaps or duplicates", seqs)
+    sys.exit(1)
+resumed, cur = store.read_events_since(acked["cursor"])
+if [e for e in resumed if e.get("kind") != "log"]:
+    print("eventlog smoke: unacked events resurfaced after the cursor",
+          resumed)
+    sys.exit(1)
+store.eventlog.append(uid, "event", {"event": {"post_recovery": True}})
+fresh, _ = store.read_events_since(cur)
+if [e["event"] for e in fresh if e["kind"] == "event"] != [
+    {"post_recovery": True}
+]:
+    print("eventlog smoke: resumed cursor missed the first post-recovery "
+          "commit", fresh)
+    sys.exit(1)
+
+server = make_server(store, port=0)
+port = server.server_address[1]
+threading.Thread(target=server.serve_forever, daemon=True).start()
+try:
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+finally:
+    server.shutdown()
+with open("tpu_results/eventlog_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "store_appends_total",
+    "store_recovered_tails_total",
+    "store_fsync_ms_bucket",
+    "store_compactions_total",
+    "store_watch_cursor_lag",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("eventlog smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"event-log crash smoke: ok ({len(required)} required series "
+      f"present, {int(tails)} torn tail(s) recovered, "
+      f"{len(recovered)} committed records intact, cursor resumed clean)")
+PY
+    then
+      echo "EVENTLOG-CRASH-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
