@@ -668,11 +668,23 @@ def streams():
 @streams.command("start")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", default=8585, type=int)
-def streams_start(host, port):
+@click.option("--federate", "federate_specs", multiple=True,
+              metavar="SLUG=URL",
+              help="sibling registry to federate on /metricsz "
+                   "(repeatable), e.g. agent=http://127.0.0.1:9090")
+def streams_start(host, port, federate_specs):
     """Serve the run store over HTTP (logs/metrics/events/artifacts)."""
     from ..streams import serve
 
-    serve(RunStore(), host=host, port=port)
+    sources: dict[str, str] = {}
+    for spec in federate_specs:
+        slug, sep, src_url = spec.partition("=")
+        if not sep or not slug or not src_url:
+            raise click.ClickException(
+                f"--federate takes SLUG=URL, got {spec!r}"
+            )
+        sources[slug] = src_url
+    serve(RunStore(), host=host, port=port, federate=sources or None)
 
 
 @cli.group()
@@ -1017,6 +1029,7 @@ def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
         registry=registry,
         scaler=manager if autoscale is not None else None,
         autoscale=autoscale,
+        trace=overrides.get("trace", True),
     )
     manager.attach_router(router)
     click.echo(f"starting {n} replica(s)...")
@@ -1372,6 +1385,72 @@ def events(ref, follow, timeout):
     for rec in store.watch("0:0", timeout=timeout, stop=_terminal):
         if rec.get("r") == uid:
             click.echo(json.dumps(rec, default=str))
+
+
+@cli.command()
+@click.argument("ref")
+@click.option("--url", default=None,
+              help="streams server base URL (default: read the local "
+                   "store directly)")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="emit raw timeline entries, one JSON object per line")
+def timeline(ref, url, as_json):
+    """A run's causally ordered story, folded from its event log.
+
+    Status transitions, retries, preemptions and resumes, elastic
+    resizes, and checkpoint-tier fallbacks in commit order — one per-run
+    log read, no directory scans. With --url, asks a streams server's
+    /runs/<ref>/timeline instead of the local store.
+    """
+    if url is not None:
+        entries = _http_json(
+            f"{url.rstrip('/')}/runs/{ref}/timeline"
+        )["timeline"]
+    else:
+        from ..store.local import UnknownRunError
+
+        store = RunStore()
+        try:
+            uid = store.resolve(ref)
+        except UnknownRunError as e:
+            raise click.ClickException(str(e.args[0]) if e.args else str(e))
+        entries = store.timeline(uid)
+    if as_json:
+        for e in entries:
+            click.echo(json.dumps(e, default=str))
+        return
+    import datetime
+
+    for e in entries:
+        ts = e.get("ts")
+        when = (
+            datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+            if isinstance(ts, (int, float))
+            else "--:--:--"
+        )
+        click.echo(
+            f"#{e.get('seq', '?'):<5} {when}  "
+            f"{e.get('kind', '?'):<11} {e.get('label', '')}"
+        )
+
+
+@cli.command()
+@click.option("--url", default="http://127.0.0.1:8080", show_default=True,
+              help="router base URL (fleet serving)")
+@click.option("--interval", default=2.0, type=float, show_default=True,
+              help="refresh interval (seconds)")
+@click.option("--once", is_flag=True, default=False,
+              help="print one frame and exit (no screen clearing)")
+def top(url, interval, once):
+    """Live cluster dashboard: fleet, router replicas, SLO burn, runs.
+
+    Fleet chips and active runs come from the local store's event-log
+    watch cursor (zero directory scans between frames); replica health,
+    queue wait, and cluster rollups come from the router's federated
+    /statsz; SLO burn from /sloz. Ctrl-C exits."""
+    from .top import run_top
+
+    run_top(RunStore(), url.rstrip("/"), interval=interval, once=once)
 
 
 @cli.group("store")

@@ -1,0 +1,247 @@
+"""`polyaxon top`: a live terminal dashboard over the observability plane.
+
+One frame stitches the cluster's three vantage points:
+
+* **runs** — seeded once from the store's committed event log
+  (``read_events_since(None)``: an index read, never a directory scan)
+  and advanced between frames by the PR 11 watch cursor
+  (``wait_events``), so the refresh cost is O(new events), not O(runs).
+* **router** — the federated ``/statsz``: per-replica health, queue
+  depth/wait, in-flight, plus the cluster rollup block and trace-ring
+  stats the router computes from its own poll loop's scrapes.
+* **SLOs** — ``/sloz`` burn rates, rendered as the worst-window burn per
+  objective.
+
+The renderer is deliberately dumb: build the frame as a list of lines,
+clear-and-repaint (ANSI home+clear) each interval. ``--once`` prints a
+single frame with no escape codes — that mode is the test surface and
+works over a pipe.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sys
+from typing import Optional, TextIO
+from urllib import request as urlrequest
+
+from ..schemas.lifecycle import DONE_STATUSES, V1Statuses
+
+#: statuses worth a line in the "active runs" pane, busiest first
+_ACTIVE_ORDER = (
+    "running", "starting", "compiled", "scheduled", "queued",
+    "awaiting_cache", "resuming", "retrying", "stopping", "created",
+)
+
+
+def _fetch_json(url: str, timeout: float = 2.0) -> Optional[dict]:
+    try:
+        with urlrequest.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — a dead surface is data, not a fault
+        return None
+
+
+class _RunTable:
+    """uid → {status, name, project}, folded from event-log records."""
+
+    def __init__(self):
+        self.runs: dict[str, dict] = {}
+
+    def apply(self, records: list[dict]) -> None:
+        for rec in records:
+            uid = rec.get("r")
+            if not uid:
+                continue
+            kind = rec.get("kind")
+            if kind == "create":
+                self.runs.setdefault(uid, {}).update(
+                    name=rec.get("name"),
+                    project=rec.get("project"),
+                    status=V1Statuses.CREATED.value,
+                )
+            elif kind == "status":
+                self.runs.setdefault(uid, {})["status"] = rec.get("status")
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for r in self.runs.values():
+            s = str(r.get("status") or "unknown")
+            out[s] = out.get(s, 0) + 1
+        return out
+
+    def active(self) -> list[tuple[str, dict]]:
+        def _key(item):
+            s = str(item[1].get("status") or "")
+            return (
+                _ACTIVE_ORDER.index(s) if s in _ACTIVE_ORDER else 99,
+                item[0],
+            )
+
+        live = [
+            (uid, r)
+            for uid, r in self.runs.items()
+            if not _is_done(r.get("status"))
+        ]
+        return sorted(live, key=_key)
+
+
+def _is_done(status) -> bool:
+    try:
+        return V1Statuses(str(status)) in DONE_STATUSES
+    except ValueError:
+        return False
+
+
+def _fmt(v, width: int = 0, nd: int = 1) -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.{nd}f}"
+    else:
+        s = str(v)
+    return s.rjust(width) if width else s
+
+
+def render_frame(
+    *,
+    url: str,
+    fleet: Optional[dict],
+    stats: Optional[dict],
+    slo: Optional[dict],
+    runs: _RunTable,
+    when: Optional[str] = None,
+    max_runs: int = 10,
+) -> str:
+    """One dashboard frame as text (pure: all inputs passed in)."""
+    lines: list[str] = []
+    head = f"polyaxon top — {url}"
+    if when:
+        head += f"   {when}"
+    lines.append(head)
+
+    if fleet and fleet.get("configured"):
+        lines.append(
+            f"fleet    chips {fleet.get('chips_reserved', 0)}"
+            f"/{fleet.get('chips_total', 0)} reserved"
+            f"  ({len(fleet.get('reservations') or [])} gang(s))"
+        )
+
+    if stats is None:
+        lines.append("router   unreachable")
+    else:
+        lat = (stats.get("latency_ms") or {})
+        lines.append(
+            f"router   req {stats.get('requests', 0)}"
+            f"  retries {stats.get('retries', 0)}"
+            f"  shed {stats.get('upstream_shed', 0)}"
+            f"  errors {stats.get('errors', 0)}"
+            f"  p95 {_fmt(lat.get('p95'))} ms"
+            f"  routable {stats.get('routable', 0)}"
+            f"/{len(stats.get('replicas') or [])}"
+        )
+        cluster = stats.get("cluster") or {}
+        if cluster:
+            lines.append(
+                f"cluster  queue {_fmt(cluster.get('queue_depth'), nd=0)}"
+                f"  inflight {cluster.get('inflight', 0)}"
+                f"  wait_max {_fmt(cluster.get('queue_wait_ms_max'))} ms"
+                f"  served {_fmt(cluster.get('serving_requests'), nd=0)}"
+                f"  shed {_fmt(cluster.get('serving_shed'), nd=0)}"
+                + ("" if cluster.get("federation", True) else
+                   "  [federation off]")
+            )
+        replicas = stats.get("replicas") or []
+        if replicas:
+            lines.append(
+                "  replica    state      queue   wait_ms  inflight  requests"
+            )
+            for r in replicas:
+                state = (
+                    "draining" if r.get("draining")
+                    else "up" if r.get("healthy") else "down"
+                )
+                lines.append(
+                    f"  {str(r.get('slug', '?')):<9}  {state:<9}"
+                    f"{_fmt(r.get('queue_depth'), 7, nd=0)}"
+                    f"{_fmt(r.get('queue_wait_ms'), 10)}"
+                    f"{_fmt(r.get('inflight'), 10)}"
+                    f"{_fmt(r.get('requests'), 10)}"
+                )
+
+    if slo and slo.get("slos"):
+        lines.append(
+            "slo      " + "   ".join(
+                f"{s.get('name', '?')}"
+                f" burn {_fmt(s.get('burn_rate'), nd=2)}"
+                + (" BREACHED" if s.get("breached") else "")
+                for s in slo["slos"]
+            )
+        )
+
+    counts = runs.counts()
+    if counts:
+        lines.append(
+            "runs     " + "  ".join(
+                f"{k}:{counts[k]}" for k in sorted(counts)
+            )
+        )
+    active = runs.active()
+    for uid, r in active[:max_runs]:
+        name = r.get("name") or ""
+        proj = r.get("project") or ""
+        ref = f"{proj}/{name}" if proj and name else (name or uid[:12])
+        lines.append(
+            f"  {uid[:12]}  {str(r.get('status') or '?'):<12} {ref}"
+        )
+    if len(active) > max_runs:
+        lines.append(f"  ... and {len(active) - max_runs} more active")
+    return "\n".join(lines)
+
+
+def run_top(
+    store,
+    url: str,
+    *,
+    interval: float = 2.0,
+    once: bool = False,
+    out: Optional[TextIO] = None,
+) -> None:
+    """Drive the dashboard loop. ``once`` prints a single frame without
+    ANSI codes (pipe-friendly; the test surface)."""
+    out = out or sys.stdout
+    runs = _RunTable()
+    # seed from the committed log: one index read, zero directory scans
+    records, cursor = store.read_events_since(None)
+    runs.apply(records)
+    while True:
+        fleet = None
+        try:
+            from ..scheduler.fleet import Fleet
+
+            snap = Fleet(store).snapshot()
+            fleet = snap if snap.get("configured") else None
+        except Exception:  # noqa: BLE001 — fleet pane is optional
+            fleet = None
+        frame = render_frame(
+            url=url,
+            fleet=fleet,
+            stats=_fetch_json(url + "/statsz"),
+            slo=_fetch_json(url + "/sloz"),
+            runs=runs,
+            when=datetime.datetime.now().strftime("%H:%M:%S"),
+        )
+        if once:
+            out.write(frame + "\n")
+            out.flush()
+            return
+        out.write("\x1b[2J\x1b[H" + frame + "\n")
+        out.flush()
+        try:
+            # the refresh clock IS the watch cursor's long-poll bound:
+            # new commits wake the frame early, idle costs one poll
+            records, cursor = store.wait_events(cursor, timeout=interval)
+        except KeyboardInterrupt:
+            return
+        runs.apply(records)

@@ -46,37 +46,49 @@ from __future__ import annotations
 import dataclasses
 import json
 import random
-import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Sequence
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
-from ..telemetry import MetricsRegistry, new_trace_id, now as _now
+from ..telemetry import (
+    MetricsRegistry,
+    RequestTrace,
+    TraceRing,
+    new_trace_id,
+    now as _now,
+)
+from ..telemetry.federate import (
+    federate,
+    parse_prometheus_text,
+    queue_wait_delta_ms,
+)
 from ..telemetry.slo import AvailabilityObjective, SLOEngine
+from ..telemetry.tracing import graft_spans, tracez_payload
 
 # replica 503 reasons that must NOT be replayed on a sibling: the
 # request's own budget is spent, not the replica's
 _NO_RETRY_REASONS = frozenset({"deadline"})
 
-_PROM_LINE = re.compile(r"^([A-Za-z_:][\w:]*)\s+([0-9.eE+-]+|NaN)\s*$")
-
 
 def parse_prometheus(text: str) -> dict[str, float]:
-    """Flat name → value from Prometheus text exposition (the registry
-    renders no labels, so a dict is lossless)."""
-    out: dict[str, float] = {}
-    for line in text.splitlines():
-        if line.startswith("#"):
-            continue
-        m = _PROM_LINE.match(line.strip())
-        if m:
-            try:
-                out[m.group(1)] = float(m.group(2))
-            except ValueError:
-                pass
-    return out
+    """Flat name → value view of a Prometheus exposition (back-compat
+    shim over the shared parser in telemetry/federate.py; labeled
+    samples are excluded — a flat dict cannot hold them)."""
+    return parse_prometheus_text(text).flat()
+
+
+def _trace_status(code: int) -> str:
+    """HTTP status → trace status, mirroring the replica's taxonomy so
+    a stitched timeline reads one vocabulary end to end."""
+    if 200 <= code < 400:
+        return "ok"
+    if code == 503:
+        return "shed"
+    if code == 504:
+        return "deadline_exceeded"
+    return "error"
 
 
 @dataclasses.dataclass
@@ -91,6 +103,9 @@ class ReplicaState:
     queue_wait_ms: float = 0.0  # EWMA of scraped queue-wait deltas
     inflight: int = 0  # router-local outstanding forwards
     requests: int = 0  # forwards attempted at this replica
+    # last successful /metricsz scrape, verbatim — the federation source
+    # (None = last scrape failed: federation_source_up goes 0)
+    metrics_text: Optional[str] = None
     # last scraped cumulative queue-wait sums, for the delta
     _wait_sum: float = 0.0
     _wait_count: float = 0.0
@@ -172,6 +187,10 @@ class Router:
         request_timeout_s: float = 600.0,
         scaler=None,  # needs .scale_to(n) and .target (ReplicaSetManager)
         autoscale: Optional[AutoscalePolicy] = None,
+        trace: bool = True,
+        trace_ring: int = 256,
+        stitch: bool = True,
+        federate: bool = True,
     ):
         self._provider: Callable[[], Sequence[str]] = (
             endpoints if callable(endpoints) else (lambda: endpoints)
@@ -207,6 +226,26 @@ class Router:
             "router.replicas_routable",
             help="Replicas currently healthy and not draining",
         )
+        # cluster observability plane: router-side request traces (with
+        # the replica-side timeline grafted in) + metrics federation
+        self.trace_enabled = bool(trace)
+        self.stitch_enabled = bool(trace and stitch)
+        self.federate_enabled = bool(federate)
+        self.traces = TraceRing(capacity=max(1, int(trace_ring)))
+        self._m_stitched = self.telemetry.counter(
+            "router.traces_stitched",
+            help="Replica-side traces grafted into router traces",
+        )
+        self._m_stitch_misses = self.telemetry.counter(
+            "router.stitch_misses",
+            help="Upstream attempts whose replica trace could not be "
+            "fetched (sampler dropped it, or the replica died)",
+        )
+        # stitching happens at READ time (`tracez`), never on the
+        # serving path: the remote /tracez fetch is paid by the operator
+        # looking at a trace, not by the request being traced (the ≤5%
+        # federation overhead budget in benchmarks/serving_bench.py).
+        self._stitch_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._poll_thread: Optional[threading.Thread] = None
@@ -281,15 +320,19 @@ class Router:
             with urlrequest.urlopen(
                 s.url + "/metricsz", timeout=self.probe_timeout_s
             ) as r:
-                metrics = parse_prometheus(r.read().decode())
+                text = r.read().decode()
         except Exception:
-            return  # keep last-known queue signal
-        s.queue_depth = metrics.get("serving_queue_depth", 0.0)
-        wsum = metrics.get("serving_queue_wait_seconds_sum", 0.0)
-        wcount = metrics.get("serving_queue_wait_seconds_count", 0.0)
-        dc = wcount - s._wait_count
-        if dc > 0:
-            delta_ms = 1000.0 * (wsum - s._wait_sum) / dc
+            # keep last-known queue signal for balancing, but mark the
+            # federation source down — an absent replica must be visible
+            s.metrics_text = None
+            return
+        snap = parse_prometheus_text(text)
+        s.metrics_text = text
+        s.queue_depth = snap.value("serving_queue_depth", 0.0)
+        delta_ms, wsum, wcount = queue_wait_delta_ms(
+            snap, s._wait_sum, s._wait_count
+        )
+        if delta_ms is not None:
             # EWMA so one anomalous poll doesn't own the routing decision
             s.queue_wait_ms = (
                 delta_ms
@@ -381,13 +424,26 @@ class Router:
             ] or list(self._states)
 
     def forward(
-        self, body: bytes, rid: str, *, query: str = ""
+        self,
+        body: bytes,
+        rid: str,
+        *,
+        query: str = "",
+        trace: Optional[RequestTrace] = None,
     ) -> tuple[int, bytes, dict]:
         """Non-streaming forward: returns (status, payload bytes,
         headers) of the first acceptable upstream answer — payload bytes
         verbatim, so the client sees exactly what the replica wrote."""
+        t_bal = _now()
         order = self.balancer.order(self._candidates())
+        if trace is not None:
+            trace.add(
+                "balance", start=t_bal, dur_s=_now() - t_bal,
+                candidates=len(order),
+            )
         if not order:
+            if trace is not None:
+                trace.annotate("no_replicas")
             return 503, json.dumps(
                 {"error": "router: no replicas", "reason": "no_replicas"}
             ).encode(), {}
@@ -401,8 +457,19 @@ class Router:
         for i, s in enumerate(order):
             if i > 0:
                 self._m_retries.inc()
+            t_att = _now()
             status, payload, headers = self._forward_once(s, body, rid, query)
             retryable = self._retryable(status, payload)
+            if trace is not None:
+                trace.add(
+                    "upstream_attempt", start=t_att, dur_s=_now() - t_att,
+                    replica=s.slug, url=s.url, attempt=i, status=status,
+                )
+                if retryable and i + 1 < len(order):
+                    trace.annotate(
+                        "retry", attempt=i, from_replica=s.slug,
+                        status=status,
+                    )
             if not retryable:
                 return status, payload, headers
             last = (status, payload, headers)
@@ -460,7 +527,14 @@ class Router:
                 s.inflight -= 1
 
     # -------------------------------------------------------- streaming
-    def forward_stream(self, body: bytes, rid: str, *, query: str = ""):
+    def forward_stream(
+        self,
+        body: bytes,
+        rid: str,
+        *,
+        query: str = "",
+        trace: Optional[RequestTrace] = None,
+    ):
         """Generator of raw SSE frame bytes, with mid-stream failover.
 
         The happy path relays the replica's frames VERBATIM (byte
@@ -477,8 +551,16 @@ class Router:
         """
         sent: dict[int, int] = {}  # row → tokens already delivered
         done_rows: set[int] = set()
+        t_bal = _now()
         order = self.balancer.order(self._candidates())
+        if trace is not None:
+            trace.add(
+                "balance", start=t_bal, dur_s=_now() - t_bal,
+                candidates=len(order), streamed=True,
+            )
         if not order:
+            if trace is not None:
+                trace.annotate("no_replicas")
             raise _StreamError(
                 503,
                 json.dumps(
@@ -491,13 +573,33 @@ class Router:
         for i, s in enumerate(order):
             if i > 0:
                 self._m_retries.inc()
+                if trace is not None:
+                    # mid-stream death replays on a sibling (failover);
+                    # a pre-stream refusal is an ordinary retry
+                    trace.annotate(
+                        "failover" if started else "retry",
+                        attempt=i, to_replica=s.slug,
+                    )
+            t_att = _now()
             try:
                 gen = self._stream_once(s, body, rid, query, sent, done_rows)
                 for frame in gen:
                     started = True
                     yield frame
+                if trace is not None:
+                    trace.add(
+                        "upstream_attempt", start=t_att,
+                        dur_s=_now() - t_att, replica=s.slug, url=s.url,
+                        attempt=i, status=200, streamed=True,
+                    )
                 return  # terminal {"done": true} seen
             except _StreamError as e:
+                if trace is not None:
+                    trace.add(
+                        "upstream_attempt", start=t_att,
+                        dur_s=_now() - t_att, replica=s.slug, url=s.url,
+                        attempt=i, status=e.status, streamed=True,
+                    )
                 if not e.retryable:
                     if started:
                         break  # can't re-raise a status mid-stream
@@ -636,6 +738,127 @@ class Router:
             with self._rlock:
                 s.inflight -= 1
 
+    # ----------------------------------------- tracing + federation
+    def finish_trace(
+        self,
+        trace: Optional[RequestTrace],
+        status: str = "ok",
+        error: Optional[str] = None,
+    ) -> None:
+        """Close the router-side trace and admit it to the tail
+        sampler. Grafting the replica-side timeline is deferred to
+        :meth:`tracez` — the serving path never blocks on it."""
+        if trace is None:
+            return
+        trace.finish(status, error)
+        self.traces.record(trace.to_dict())
+
+    def tracez(self, query: dict) -> tuple[int, dict]:
+        """The `/tracez` HTTP contract (same as the replica's), with
+        query-time stitching: a `?id=` read grafts each attempted
+        replica's own timeline under its `upstream_attempt` span, once
+        — the payload shares `spans`/`attrs` with the ring's stored
+        trace, so the graft is cached and repeat reads are free."""
+        code, payload = tracez_payload(self.traces, query)
+        if (
+            code == 200
+            and self.stitch_enabled
+            and "spans" in payload  # a single trace, not the list view
+        ):
+            with self._stitch_lock:
+                if payload["attrs"].get("attempts") is None:
+                    self._stitch(payload)
+        return code, payload
+
+    def _stitch(self, tdict: dict) -> None:
+        rid = tdict["id"]
+        attempts = [
+            s for s in tdict.get("spans") or []
+            if s.get("name") == "upstream_attempt"
+        ]
+        stitched = 0
+        for att in attempts:
+            url = att["attrs"].get("url")
+            if not url:
+                continue
+            remote = self._fetch_remote_trace(url, rid)
+            if remote is None:
+                att["attrs"]["stitched"] = False
+                self._m_stitch_misses.inc()
+                continue
+            att["attrs"]["stitched"] = True
+            graft_spans(
+                tdict, att, remote,
+                replica=att["attrs"].get("replica"),
+                attempt=att["attrs"].get("attempt"),
+            )
+            stitched += 1
+        tdict["attrs"]["attempts"] = len(attempts)
+        tdict["attrs"]["stitched"] = stitched
+        if stitched:
+            self._m_stitched.inc(stitched)
+
+    def _fetch_remote_trace(self, url: str, rid: str) -> Optional[dict]:
+        """GET <replica>/tracez?id=<rid> — the propagation contract: the
+        replica traced the SAME id it got on the X-Request-Id hop. One
+        short retry: the replica's sampler records a streamed trace when
+        its generator closes, which can land a beat after the router has
+        read the final frame. (Event.wait, not time.sleep — lint rule 8:
+        no raw clock reads in this module.)"""
+        for attempt in range(3):
+            if attempt:
+                threading.Event().wait(0.05)
+            try:
+                with urlrequest.urlopen(
+                    url + "/tracez?id=" + rid, timeout=self.probe_timeout_s
+                ) as r:
+                    return json.loads(r.read())
+            except urlerror.HTTPError:
+                continue  # 404: not recorded (yet), retry once or twice
+            except Exception:
+                return None  # replica gone: its side of the story is lost
+        return None
+
+    def render_metrics(self) -> str:
+        """The federated `/metricsz` text: the router's own registry,
+        every replica's last scrape re-labeled `replica="r<N>"`, and
+        cluster `cluster:<series>:sum/:max` aggregates — one scrape sees
+        the fleet."""
+        local = self.telemetry.render_prometheus()
+        if not self.federate_enabled:
+            return local
+        sources = [
+            (s.slug, s.metrics_text) for s in self.states()
+        ]
+        return federate(sources, label="replica", local_text=local)
+
+    def cluster_stats(self) -> dict:
+        """Fleet-level rollup for `/statsz` (what `polyaxon top` renders):
+        sums/maxes over the replicas' scraped series plus router-local
+        inflight — no extra scrape, just the poll loop's last pass."""
+        states = self.states()
+        snaps = [
+            parse_prometheus_text(s.metrics_text)
+            for s in states
+            if s.metrics_text
+        ]
+        return {
+            "federation": self.federate_enabled,
+            "replicas": len(states),
+            "scraped": len(snaps),
+            "queue_depth": sum(s.queue_depth for s in states),
+            "inflight": sum(s.inflight for s in states),
+            "queue_wait_ms_max": round(
+                max((s.queue_wait_ms for s in states), default=0.0), 3
+            ),
+            "serving_requests": sum(
+                snap.value("serving_requests_total") for snap in snaps
+            ),
+            "serving_shed": sum(
+                snap.value("serving_shed_total") for snap in snaps
+            ),
+        }
+
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:
         lat = self._m_latency.summary()
@@ -673,6 +896,14 @@ class Router:
                 for k in ("p50", "p95", "p99", "mean")
             },
             "autoscale": auto,
+            "tracing": {
+                "enabled": self.trace_enabled,
+                "stitch": self.stitch_enabled,
+                "stitched": int(self._m_stitched.value),
+                "stitch_misses": int(self._m_stitch_misses.value),
+                **self.traces.stats(),
+            },
+            "cluster": self.cluster_stats(),
         }
 
     def readiness(self) -> tuple[bool, str]:
@@ -732,9 +963,12 @@ class Router:
                 elif path == "/metricsz":
                     self._send_raw(
                         200,
-                        router.telemetry.render_prometheus().encode(),
+                        router.render_metrics().encode(),
                         "text/plain; version=0.0.4",
                     )
+                elif path == "/tracez":
+                    code, payload = router.tracez(_query)
+                    self._send(code, payload)
                 elif path == "/sloz":
                     self._send(
                         200,
@@ -756,15 +990,30 @@ class Router:
                 )
                 router._m_requests.inc()
                 t0 = _now()
+                tr = (
+                    RequestTrace(rid, role="router")
+                    if router.trace_enabled
+                    else None
+                )
+                status_out, err_out = "ok", None
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
+                    if tr is not None:
+                        tr.add(
+                            "admission",
+                            start=tr.t0,
+                            dur_s=_now() - tr.t0,
+                            bytes=len(body),
+                        )
                     if "stream=1" in query.split("&"):
-                        self._relay_stream(body, rid, query)
+                        status = self._relay_stream(body, rid, query, tr)
+                        status_out = _trace_status(status)
                     else:
                         status, payload, headers = router.forward(
-                            body, rid, query=query
+                            body, rid, query=query, trace=tr
                         )
+                        status_out = _trace_status(status)
                         fwd = {
                             k: v
                             for k, v in headers.items()
@@ -775,9 +1024,11 @@ class Router:
                             status, payload, "application/json", fwd
                         )
                 except BrokenPipeError:
-                    pass  # client went away; nothing to answer
+                    status_out, err_out = "error", "client disconnected"
                 except Exception as e:  # noqa: BLE001 — surface, don't kill
                     router._m_errors.inc()
+                    status_out = "error"
+                    err_out = f"{type(e).__name__}: {e}"
                     try:
                         self._send(
                             500,
@@ -790,9 +1041,10 @@ class Router:
                         pass
                 finally:
                     router._m_latency.observe(_now() - t0, exemplar=rid)
+                    router.finish_trace(tr, status_out, err_out)
 
-            def _relay_stream(self, body, rid, query):
-                gen = router.forward_stream(body, rid, query=query)
+            def _relay_stream(self, body, rid, query, tr=None):
+                gen = router.forward_stream(body, rid, query=query, trace=tr)
                 try:
                     first = next(gen)  # admission errors raise here
                 except _StreamError as e:
@@ -805,10 +1057,10 @@ class Router:
                     self._send_raw(
                         e.status, e.payload, "application/json", fwd
                     )
-                    return
+                    return e.status
                 except StopIteration:
                     self._send(502, {"error": "router: empty stream"})
-                    return
+                    return 502
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-store")
@@ -823,6 +1075,7 @@ class Router:
                         self.wfile.flush()
                 except BrokenPipeError:
                     pass
+                return 200
 
         self._httpd = _RouterHttpd((host, port), Handler)
         self._thread = threading.Thread(

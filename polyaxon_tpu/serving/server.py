@@ -2049,27 +2049,12 @@ class ModelServer:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def _tracez(self, query: str):
-                from urllib.parse import parse_qs
+                # ONE /tracez contract across every surface that owns a
+                # ring (replica here, router): shared in telemetry
+                from ..telemetry.tracing import tracez_payload
 
-                q = parse_qs(query)
-                tid = (q.get("id") or [None])[0]
-                if tid is not None:
-                    tr = server.traces.get(tid)
-                    if tr is None:
-                        self._send(404, {"error": f"no trace {tid!r}"})
-                    else:
-                        self._send(200, tr)
-                    return
-                try:
-                    n = int((q.get("n") or ["50"])[0])
-                    sort = (q.get("sort") or ["recent"])[0]
-                    traces = server.traces.list(n=n, sort=sort)
-                except ValueError as e:
-                    self._send(400, {"error": str(e)})
-                    return
-                self._send(
-                    200, {"traces": traces, **server.traces.stats()}
-                )
+                code, payload = tracez_payload(server.traces, query)
+                self._send(code, payload)
 
             def _stream(self, body, rid):
                 """SSE response: one `data: <json>` frame per event from

@@ -266,6 +266,15 @@ class RunStore:
         self._ensure_migrated(run_uuid)
         return self.eventlog.history(run_uuid)
 
+    def timeline(self, run_uuid: str) -> list[dict]:
+        """The run's causally ordered operator-facing timeline, folded
+        from committed event-log records (transitions, retries,
+        preemptions, elastic resizes, checkpoint tiers). One per-run log
+        read — never a directory scan."""
+        from .timeline import fold_timeline
+
+        return fold_timeline(self.get_history(run_uuid))
+
     def recover(self, run_uuid: Optional[str] = None):
         """Crash recovery: heal interrupted batches, truncate torn tails,
         quarantine corrupt segments, refresh status.json views. One run,

@@ -277,6 +277,19 @@ def spec() -> dict:
                     "responses": {"200": {"description": "event rows"}},
                 }
             },
+            "/runs/{uuid}/timeline": {
+                "get": {
+                    "summary": (
+                        "Causally ordered operator timeline folded from "
+                        "the run's event log (transitions, retries, "
+                        "preemptions, elastic resizes, checkpoint tiers)"
+                    ),
+                    "parameters": [run_param],
+                    "responses": {
+                        "200": {"description": "{uuid, timeline: [...]}"}
+                    },
+                }
+            },
             "/runs/{uuid}/spec": {
                 "get": {
                     "summary": "Resolved run spec (params, component)",

@@ -14,6 +14,8 @@ over the run store — the same files the trainer/sidecar write. Endpoints:
   GET  /runs/<uuid>/logs[?offset=N]  → text; offset supports tail-follow
   GET  /runs/<uuid>/metrics
   GET  /runs/<uuid>/events
+  GET  /runs/<uuid>/timeline         → causally ordered operator timeline
+                                       folded from the run's event log
   GET  /runs/<uuid>/artifacts        → list outputs tree
   GET  /runs/<uuid>/artifacts/<path> → file download
   POST /runs                         → create: {"operation": <V1Operation>,
@@ -68,6 +70,9 @@ def _query_int(query: dict, name: str, default: int) -> int:
 
 class _Handler(BaseHTTPRequestHandler):
     store: RunStore  # injected by make_server
+    #: optional {slug: base_url} of sibling registries (agents, trainers)
+    #: whose /metricsz this server federates; injected by make_server
+    federate_sources: dict[str, str] = {}
 
     def log_message(self, *args):  # quiet
         pass
@@ -102,13 +107,29 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, _json_bytes({"ready": True}))
             if parts == ["metricsz"]:
                 # process-wide registry: run-store transitions, retry/
-                # backoff counters, chaos injections (telemetry package)
+                # backoff counters, chaos injections (telemetry package).
+                # With federate sources configured, sibling registries
+                # (agents, trainers) are scraped and re-exported with a
+                # source="<slug>" label plus cluster aggregates — one
+                # scrape of the streams server sees every process.
                 from ..telemetry import get_registry
 
+                local = get_registry().render_prometheus()
+                if self.federate_sources:
+                    from ..telemetry.federate import federate
+
+                    local = federate(
+                        [
+                            (slug, _scrape(url))
+                            for slug, url in sorted(
+                                self.federate_sources.items()
+                            )
+                        ],
+                        label="source",
+                        local_text=local,
+                    )
                 return self._send(
-                    200,
-                    get_registry().render_prometheus().encode(),
-                    "text/plain; version=0.0.4",
+                    200, local.encode(), "text/plain; version=0.0.4"
                 )
             if parts == ["openapi.json"]:
                 from .openapi import spec as openapi_spec
@@ -166,6 +187,13 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send(200, _json_bytes(rows))
                 if sub == "events":
                     return self._send(200, _json_bytes(store.read_events(uuid)))
+                if sub == "timeline":
+                    return self._send(
+                        200,
+                        _json_bytes(
+                            {"uuid": uuid, "timeline": store.timeline(uuid)}
+                        ),
+                    )
                 if sub == "spec":
                     return self._send(200, _json_bytes(store.read_spec(uuid)))
                 if sub == "artifacts":
@@ -270,17 +298,42 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, _json_bytes({"error": str(e)}))
 
 
+def _scrape(url: str) -> Optional[str]:
+    """Fetch one sibling registry's exposition text; None marks the
+    source down (federate() renders it as federation_source_up 0)."""
+    from urllib import request as urlrequest
+
+    try:
+        with urlrequest.urlopen(url.rstrip("/") + "/metricsz", timeout=2.0) as r:
+            return r.read().decode()
+    except Exception:  # noqa: BLE001 — a dead source is data, not a fault
+        return None
+
+
 def make_server(
-    store: Optional[RunStore] = None, host: str = "127.0.0.1", port: int = 8585
+    store: Optional[RunStore] = None,
+    host: str = "127.0.0.1",
+    port: int = 8585,
+    federate: Optional[dict[str, str]] = None,
 ) -> ThreadingHTTPServer:
-    handler = type("BoundHandler", (_Handler,), {"store": store or RunStore()})
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {
+            "store": store or RunStore(),
+            "federate_sources": dict(federate or {}),
+        },
+    )
     return ThreadingHTTPServer((host, port), handler)
 
 
 def serve(
-    store: Optional[RunStore] = None, host: str = "127.0.0.1", port: int = 8585
+    store: Optional[RunStore] = None,
+    host: str = "127.0.0.1",
+    port: int = 8585,
+    federate: Optional[dict[str, str]] = None,
 ):
-    server = make_server(store, host, port)
+    server = make_server(store, host, port, federate=federate)
     print(f"polyaxon streams serving on http://{host}:{port}")
     try:
         server.serve_forever()
@@ -291,8 +344,12 @@ def serve(
 class BackgroundServer:
     """Test/embedding helper: serve on a free port in a daemon thread."""
 
-    def __init__(self, store: Optional[RunStore] = None):
-        self.server = make_server(store, port=0)
+    def __init__(
+        self,
+        store: Optional[RunStore] = None,
+        federate: Optional[dict[str, str]] = None,
+    ):
+        self.server = make_server(store, port=0, federate=federate)
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(
             target=self.server.serve_forever, daemon=True

@@ -35,6 +35,13 @@ Import cost is stdlib-only — safe to import from anywhere in the
 package without cycles.
 """
 
+from .federate import (
+    PromSample,
+    PromSnapshot,
+    federate,
+    parse_prometheus_text,
+    queue_wait_delta_ms,
+)
 from .registry import (
     Counter,
     Gauge,
@@ -52,7 +59,7 @@ from .slo import (
 )
 from .spans import SpanTracer, get_tracer
 from .stats import mfu, quantile, summarize, train_step_flops
-from .tracing import RequestTrace, TraceRing, new_trace_id
+from .tracing import RequestTrace, TraceRing, new_trace_id, tracez_payload
 
 __all__ = [
     "AvailabilityObjective",
@@ -62,14 +69,20 @@ __all__ = [
     "Histogram",
     "LatencyObjective",
     "MetricsRegistry",
+    "PromSample",
+    "PromSnapshot",
     "RequestTrace",
     "SLOEngine",
     "SpanTracer",
     "TraceRing",
     "build_objectives",
+    "federate",
     "get_registry",
     "get_tracer",
     "new_trace_id",
+    "parse_prometheus_text",
+    "queue_wait_delta_ms",
+    "tracez_payload",
     "mfu",
     "now",
     "quantile",

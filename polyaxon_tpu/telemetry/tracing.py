@@ -152,6 +152,72 @@ class RequestTrace:
             return d
 
 
+def tracez_payload(ring: "TraceRing", query: str) -> tuple[int, dict]:
+    """THE `/tracez` HTTP contract, shared by every surface that owns a
+    ring (replica server, router): ``?id=`` returns the full trace dict
+    (404 with ``{"error": "no trace ..."}`` when the sampler dropped or
+    never saw it), otherwise a summary list honoring ``?n=`` and
+    ``?sort=recent|slowest|errors`` (bad sort/n → 400). Returns
+    ``(status, payload)`` — the handler just serializes."""
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query)
+    tid = (q.get("id") or [None])[0]
+    if tid is not None:
+        tr = ring.get(tid)
+        if tr is None:
+            return 404, {"error": f"no trace {tid!r}"}
+        return 200, tr
+    try:
+        n = int((q.get("n") or ["50"])[0])
+        sort = (q.get("sort") or ["recent"])[0]
+        traces = ring.list(n=n, sort=sort)
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    return 200, {"traces": traces, **ring.stats()}
+
+
+def graft_spans(
+    tdict: dict,
+    anchor: dict,
+    remote: dict,
+    **attrs,
+) -> int:
+    """Cross-process stitching: splice a remote trace's spans into
+    ``tdict`` under ``anchor`` (a span record already in ``tdict``).
+
+    Remote ``start_s`` offsets are relative to the REMOTE trace start;
+    re-anchoring them at the anchor span's start keeps one coherent
+    timeline on the local clock without ever comparing the two
+    processes' clocks directly (the anchor's wall window already brackets
+    the remote work — HTTP request/response order guarantees it). The
+    anchor gains ``remote_status``/``remote_dur_ms`` attrs; every
+    grafted span carries the extra ``attrs`` (replica slug, attempt
+    index) plus ``remote: True``. Returns the number of spans grafted.
+    """
+    anchor["attrs"]["remote_status"] = remote.get("status")
+    anchor["attrs"]["remote_dur_ms"] = remote.get("dur_ms")
+    if remote.get("error"):
+        anchor["attrs"]["remote_error"] = remote["error"]
+    base = anchor.get("start_s", 0.0)
+    grafted = 0
+    for rs in remote.get("spans") or []:
+        tdict["spans"].append(
+            {
+                "name": rs.get("name", "?"),
+                "start_s": base + float(rs.get("start_s") or 0.0),
+                "dur_s": float(rs.get("dur_s") or 0.0),
+                "attrs": {
+                    **(rs.get("attrs") or {}),
+                    **attrs,
+                    "remote": True,
+                },
+            }
+        )
+        grafted += 1
+    return grafted
+
+
 def _summary(tdict: dict) -> dict:
     spans = tdict.get("spans") or []
     return {

@@ -89,6 +89,18 @@ chaos tests could no longer replay byte-identical histories — and
 `datetime.now/utcnow/today` call in that file is forbidden: order by
 sequence number, take clocks through the constructor.
 
+Tenth rule: NO clock at all in metrics federation or timeline folding.
+Federation (`polyaxon_tpu/telemetry/federate.py`) is a pure text
+transform — parse N scraped expositions, re-label, aggregate — and the
+run timeline (`polyaxon_tpu/store/timeline.py`) is a pure fold over
+committed event-log records whose ordering authority is the sequence
+number. A raw `time.*()` / `datetime.now()` read in either would smuggle
+a time axis into layers whose whole correctness story is that they have
+none (federated aggregates must be reproducible from the same scrape
+texts; timelines must replay byte-identical from the same log). Any
+direct `time.time/monotonic/perf_counter/sleep` (and `_ns` variants) or
+`datetime.now/utcnow/today` call in those files is forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -146,6 +158,16 @@ STORE_PATTERN = re.compile(
 STORE_MODULES = (
     ("polyaxon_tpu", "store", "eventlog.py"),
 )
+PURE_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: clock-free pure transforms: federation text rewriting and the
+#: event-log timeline fold (rule 10)
+PURE_MODULES = (
+    ("polyaxon_tpu", "telemetry", "federate.py"),
+    ("polyaxon_tpu", "store", "timeline.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -168,6 +190,17 @@ def violations(repo_root: Path) -> list[str]:
                             f"layer — inject the telemetry clock "
                             f"(registry.now): {line.strip()}"
                         )
+            if rel.parts in PURE_MODULES:
+                for i, line in enumerate(
+                    py.read_text().splitlines(), 1
+                ):
+                    code = line.split("#", 1)[0]
+                    if PURE_PATTERN.search(code):
+                        out.append(
+                            f"{rel}:{i}: clock in a pure transform — "
+                            f"federation/timeline code has no time "
+                            f"axis: {line.strip()}"
+                        )
             continue
         in_scheduler = rel.parts[:2] == ("polyaxon_tpu", "scheduler")
         clock_exempt = in_scheduler and rel.name == "clock.py"
@@ -177,6 +210,7 @@ def violations(repo_root: Path) -> list[str]:
         in_spec = rel.parts in SPEC_MODULES
         in_router = rel.parts in ROUTER_MODULES
         in_store = rel.parts in STORE_MODULES
+        in_pure = rel.parts in PURE_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -222,6 +256,12 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: raw clock in the event-log store — "
                     f"order by sequence number; clocks are injected "
                     f"(wall=/mono= ctor args): {line.strip()}"
+                )
+            if in_pure and PURE_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: clock in a pure transform — "
+                    f"federation/timeline code has no time "
+                    f"axis: {line.strip()}"
                 )
     return out
 

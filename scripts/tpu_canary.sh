@@ -757,6 +757,142 @@ PY
       echo "ROUTER-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # federation gate (ISSUE 13): 2 replicas behind the router, warm
+    # traffic, then ONE router scrape must answer for the fleet —
+    # replica-labeled serving_* series plus cluster:...:sum/:max
+    # aggregates on /metricsz — and ONE router /tracez read must show a
+    # stitched router→replica timeline (the replica's own decode span
+    # grafted under the router's upstream_attempt). An observability
+    # plane that cannot see across processes FAILS.
+    echo "running metrics federation smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.retry import RetryPolicy
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.replicas import InProcessReplica, ReplicaSetManager
+from polyaxon_tpu.serving.router import P2CBalancer, Router
+from polyaxon_tpu.serving.server import ModelServer
+from polyaxon_tpu.telemetry import MetricsRegistry
+from polyaxon_tpu.telemetry.federate import parse_prometheus_text
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+
+
+def make_server():
+    return ModelServer(
+        b.module, params,
+        config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                             kv_pool_pages=64, kv_page_tokens=8,
+                             stream_chunk_tokens=3),
+    )
+
+
+reg = MetricsRegistry()
+mgr = ReplicaSetManager(
+    lambda i: InProcessReplica(make_server), replicas=2,
+    retry=RetryPolicy(max_retries=3, backoff=0.1),
+    registry=reg, monitor_interval_s=0.2,
+)
+router = Router(
+    mgr.endpoints, registry=reg, balancer=P2CBalancer(seed=7),
+    poll_interval_s=0.2,
+)
+mgr.attach_router(router)
+mgr.start()
+port = router.start("127.0.0.1", 0)
+try:
+    router.poll_once()
+    body = json.dumps({"tokens": [list(range(1, 13))],
+                       "maxNewTokens": 8}).encode()
+    warm = 6
+    for i in range(warm):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": f"canary-fed-{i}"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            if r.status != 200:
+                print("federation smoke: request failed", r.status)
+                sys.exit(1)
+            r.read()
+    router.poll_once()  # re-scrape: replica texts include the traffic
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    snap = parse_prometheus_text(text)
+    problems = []
+    for slug in ("r0", "r1"):
+        if snap.get("federation_source_up", replica=slug) != 1.0:
+            problems.append(f"federation_source_up missing for {slug}")
+        if snap.get("serving_requests_total", replica=slug) is None:
+            problems.append(f"serving_requests_total not labeled {slug}")
+    total = snap.get("cluster:serving_requests_total:sum")
+    if total is None or total < warm:
+        problems.append(f"cluster requests sum {total} < warm {warm}")
+    if snap.get("cluster:serving_queue_depth:max") is None:
+        problems.append("cluster:serving_queue_depth:max missing")
+    if problems:
+        print("federation smoke:", "; ".join(problems))
+        sys.exit(1)
+
+    trace = None
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            trace = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tracez?id=canary-fed-0",
+                timeout=30,
+            ).read())
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            time.sleep(0.1)
+    if trace is None:
+        print("federation smoke: router trace never recorded")
+        sys.exit(1)
+    if not trace["attrs"].get("stitched"):
+        print("federation smoke: trace not stitched", trace["attrs"])
+        sys.exit(1)
+    decode = [s for s in trace["spans"]
+              if s["name"] == "decode" and s["attrs"].get("remote")]
+    if not decode:
+        print("federation smoke: no replica-side decode span grafted",
+              [s["name"] for s in trace["spans"]])
+        sys.exit(1)
+finally:
+    router.stop()
+    mgr.stop()
+with open("tpu_results/router_federated_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+with open("tpu_results/router_stitched_trace_tpu.json", "w") as f:
+    json.dump(trace, f, indent=2)
+print(f"metrics federation smoke: ok (cluster sum {total:g} requests "
+      f"across 2 replicas, stitched trace with {len(decode)} remote "
+      f"decode span(s))")
+PY
+    then
+      echo "FEDERATION-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     # event-log crash gate: a REAL run through the Agent/Fleet stack,
     # then the store writer takes a real SIGKILL mid-append (seeded
     # garbage lands on the live segment first — the torn tail a power

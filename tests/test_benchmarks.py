@@ -202,6 +202,31 @@ def test_serving_bench_trace_overhead_schema(tmp_home):
     assert r["value"] <= 5.0, r
 
 
+def test_serving_bench_federation_overhead_schema(tmp_home):
+    proc = _run(
+        "benchmarks/serving_bench.py", "--smoke", "--federation-overhead",
+        timeout=560,
+    )
+    # rc=1 is the script's own gate (plane cost above 5% p95, or the
+    # on-router never actually federated) — fail loudly
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    recs = _records(proc)
+    assert len(recs) == 1, recs
+    r = recs[0]
+    assert r["metric"] == "serving_federation_overhead" and r["unit"] == "%"
+    assert {
+        "value", "p95_on_ms", "p95_off_ms", "req_per_sec_on",
+        "req_per_sec_off", "federated_series", "cluster_aggregates",
+        "replicas", "repeats",
+    } <= r.keys(), r
+    assert r["req_per_sec_on"] > 0 and r["req_per_sec_off"] > 0
+    # the on-router must have really federated and stitched, otherwise
+    # the overhead number measures nothing
+    assert r["federated_series"] is True
+    assert r["cluster_aggregates"] is True
+    assert r["value"] <= 5.0, r
+
+
 def test_serving_bench_router_schema(tmp_home):
     proc = _run(
         "benchmarks/serving_bench.py", "--smoke", "--router",

@@ -1,0 +1,382 @@
+"""Cluster observability plane (ISSUE 13): the shared Prometheus parser,
+the federation renderer, event-log run timelines, and the surfaces that
+expose them (streams `/metricsz` + `/runs/<uuid>/timeline`,
+`polyaxon timeline`, `polyaxon top --once`).
+
+The live router-side pieces (trace stitching, federated router
+/metricsz on a real 2-replica rig) live in tests/test_router.py — this
+file covers the pure transforms and the store/streams/CLI surfaces,
+none of which need a model.
+"""
+
+import io
+import json
+import math
+import urllib.error
+import urllib.request
+
+import pytest
+
+from polyaxon_tpu.schemas.lifecycle import V1Statuses
+from polyaxon_tpu.store.local import RunStore
+from polyaxon_tpu.store.timeline import fold_timeline
+from polyaxon_tpu.telemetry.federate import (
+    federate,
+    parse_prometheus_text,
+    queue_wait_delta_ms,
+    render_sample,
+    sum_values,
+)
+
+RUN = "feedfacefeedface"
+
+
+# ------------------------------------------------------------- parser
+
+
+def test_parse_basic_names_values_and_flat():
+    snap = parse_prometheus_text(
+        "# HELP serving_queue_depth rows waiting\n"
+        "# TYPE serving_queue_depth gauge\n"
+        "serving_queue_depth 3\n"
+        "serving_requests_total 120\n"
+        "serving_latency_seconds_sum 1.5 1712345678\n"  # timestamp ignored\n
+        "not a metric line at all\n"
+    )
+    assert snap.flat() == {
+        "serving_queue_depth": 3.0,
+        "serving_requests_total": 120.0,
+        "serving_latency_seconds_sum": 1.5,
+    }
+    assert snap.names() == [
+        "serving_queue_depth",
+        "serving_requests_total",
+        "serving_latency_seconds_sum",
+    ]
+    assert snap.types["serving_queue_depth"] == "gauge"
+    assert len(snap) == 3
+
+
+def test_parse_labels_histogram_components_and_special_values():
+    snap = parse_prometheus_text(
+        'serving_latency_seconds_bucket{le="0.1"} 4\n'
+        'serving_latency_seconds_bucket{le="+Inf"} 9\n'
+        "serving_latency_seconds_sum 0.42\n"
+        "serving_latency_seconds_count 9\n"
+        "weird_gauge NaN\n"
+        "hot_gauge +Inf\n"
+    )
+    assert snap.value("serving_latency_seconds_bucket", le="0.1") == 4.0
+    assert snap.value("serving_latency_seconds_bucket", le="+Inf") == 9.0
+    assert math.isnan(snap.value("weird_gauge"))
+    assert snap.value("hot_gauge") == float("inf")
+    # labeled series never leak into the legacy flat view
+    assert "serving_latency_seconds_bucket" not in snap.flat()
+
+
+def test_parse_label_escapes_roundtrip():
+    labels = {"path": 'a\\b"c\nd', "slug": "r0"}
+    line = render_sample("fs_ops_total", labels, 7)
+    snap = parse_prometheus_text(line + "\n")
+    assert snap.get("fs_ops_total", path='a\\b"c\nd', slug="r0") == 7.0
+    # superset match: fewer constraints still hit the same sample
+    assert snap.get("fs_ops_total", slug="r0") == 7.0
+    # mismatched label value misses -> default
+    assert snap.get("fs_ops_total", 42.0, slug="r1") == 42.0
+
+
+def test_render_sample_int_and_sorted_labels():
+    assert render_sample("x_total", {}, 5.0) == "x_total 5"
+    assert (
+        render_sample("x", {"b": "2", "a": "1"}, 0.5)
+        == 'x{a="1",b="2"} 0.5'
+    )
+
+
+def test_queue_wait_delta_ms():
+    snap = parse_prometheus_text(
+        "serving_queue_wait_seconds_sum 0.9\n"
+        "serving_queue_wait_seconds_count 30\n"
+    )
+    # 10 new observations, 0.5s of new wait -> 50 ms mean
+    delta, wsum, wcount = queue_wait_delta_ms(snap, 0.4, 20.0)
+    assert (delta, wsum, wcount) == (50.0, 0.9, 30.0)
+    # no new observation: None, caller keeps its EWMA
+    delta, _, _ = queue_wait_delta_ms(snap, 0.9, 30.0)
+    assert delta is None
+
+
+# ----------------------------------------------------------- federate
+
+
+def _replica_text(depth, requests):
+    return (
+        "# TYPE serving_queue_depth gauge\n"
+        "# TYPE serving_requests_total counter\n"
+        f"serving_queue_depth {depth}\n"
+        f"serving_requests_total {requests}\n"
+    )
+
+
+def test_federate_relabels_and_aggregates():
+    text = federate(
+        [("r0", _replica_text(2, 10)), ("r1", _replica_text(3, 5))],
+        label="replica",
+        local_text="router_requests_total 15\n",
+    )
+    snap = parse_prometheus_text(text)
+    # local series pass through verbatim (no replica label)
+    assert snap.get("router_requests_total") == 15.0
+    # every replica series carries its identity label
+    assert snap.get("serving_queue_depth", replica="r0") == 2.0
+    assert snap.get("serving_queue_depth", replica="r1") == 3.0
+    assert snap.get("federation_source_up", replica="r0") == 1.0
+    # cluster rollups: sum for everything, max only for gauge-shaped
+    assert snap.get("cluster:serving_queue_depth:sum") == 5.0
+    assert snap.get("cluster:serving_queue_depth:max") == 3.0
+    assert snap.get("cluster:serving_requests_total:sum") == 15.0
+    assert snap.get("cluster:serving_requests_total:max") is None
+
+
+def test_federate_dead_source_is_visible_not_silent():
+    text = federate(
+        [("r0", _replica_text(1, 1)), ("r1", None)], label="replica"
+    )
+    snap = parse_prometheus_text(text)
+    assert snap.get("federation_source_up", replica="r0") == 1.0
+    assert snap.get("federation_source_up", replica="r1") == 0.0
+    assert snap.get("serving_queue_depth", replica="r1") is None
+    # aggregates cover only the live source
+    assert snap.get("cluster:serving_queue_depth:sum") == 1.0
+
+
+def test_federate_groups_histogram_buckets_per_le():
+    t = 'lat_bucket{le="0.1"} 2\nlat_bucket{le="+Inf"} 4\n'
+    snap = parse_prometheus_text(
+        federate([("r0", t), ("r1", t)], label="replica")
+    )
+    assert snap.get("cluster:lat_bucket:sum", le="0.1") == 4.0
+    assert snap.get("cluster:lat_bucket:sum", le="+Inf") == 8.0
+    # _bucket is counter-shaped: no max
+    assert snap.get("cluster:lat_bucket:max", le="0.1") is None
+
+
+def test_federate_identity_label_wins_over_preexisting():
+    snap = parse_prometheus_text(
+        federate([("r7", 'up{replica="stale"} 1\n')], label="replica")
+    )
+    assert snap.get("up", replica="r7") == 1.0
+    assert snap.get("up", replica="stale") is None
+
+
+def test_sum_values_tolerates_missing():
+    snaps = [
+        parse_prometheus_text("serving_shed_total 2\n"),
+        None,
+        parse_prometheus_text("other 9\n"),
+    ]
+    assert sum_values(snaps, "serving_shed_total") == 2.0
+
+
+# ------------------------------------------------------ run timelines
+
+
+def _drive_preempted_run(store, run=RUN):
+    """A run that gets preempted mid-flight and resumes — the ISSUE's
+    acceptance scenario for `polyaxon timeline`."""
+    store.create_run(run, "trainer-1", "proj", {"op": 1})
+    for s in (
+        V1Statuses.COMPILED,
+        V1Statuses.QUEUED,
+        V1Statuses.SCHEDULED,
+        V1Statuses.STARTING,
+        V1Statuses.RUNNING,
+    ):
+        store.set_status(run, s)
+    store.log_event(run, "preempted", {"step": 120, "resume_step": 100})
+    store.set_status(run, V1Statuses.RETRYING, reason="Preempted")
+    store.set_meta(run, preempt_restarts=1)
+    store.set_status(run, V1Statuses.QUEUED)
+    store.set_status(run, V1Statuses.SCHEDULED)
+    store.set_status(run, V1Statuses.RUNNING)
+    store.log_event(run, "resumed", {"step": 100, "tier": "local"})
+    store.set_status(run, V1Statuses.SUCCEEDED)
+    return run
+
+
+def test_fold_timeline_pure_categories_and_labels():
+    history = [
+        {"kind": "create", "seq": 1, "ts": 10.0, "name": "n", "project": "p"},
+        {
+            "kind": "status", "seq": 2, "ts": 11.0, "status": "running",
+            "cond": {"reason": "PodStarted", "message": "ok"},
+        },
+        {
+            "kind": "event", "seq": 3, "ts": 12.0,
+            "event": {"kind": "preempted", "step": 7, "resume_step": 5},
+        },
+        {
+            "kind": "event", "seq": 4, "ts": 13.0,
+            "event": {"kind": "elastic_shrink", "granted": 4, "requested": 8},
+        },
+        {"kind": "meta", "seq": 5, "ts": 14.0,
+         "entries": {"preempt_restarts": 2}},
+        {
+            "kind": "event", "seq": 6, "ts": 15.0,
+            "event": {"kind": "never_seen_before", "x": 1},
+        },
+    ]
+    entries = fold_timeline(history)
+    assert [e["kind"] for e in entries] == [
+        "created", "transition", "preemption", "elastic", "meta", "event",
+    ]
+    assert entries[0]["label"] == "created p/n"
+    assert entries[1]["label"] == "-> running (PodStarted)"
+    assert entries[2]["label"] == "preempted (step 7, resume at 5)"
+    assert entries[3]["label"] == "elastic shrink: granted 4 of 8 chips"
+    assert entries[4]["label"] == "preemption restarts: 2"
+    # unknown inner kinds degrade to readable words, never drop
+    assert entries[5]["label"] == "never seen before"
+    assert [e["seq"] for e in entries] == [1, 2, 3, 4, 5, 6]
+
+
+def test_store_timeline_preempt_resume_zero_scans(tmp_path):
+    store = RunStore(tmp_path / "store")
+    _drive_preempted_run(store)
+    before = store.scans
+    entries = store.timeline(RUN)
+    assert store.scans == before == 0  # one log read, no directory scans
+
+    kinds = [e["kind"] for e in entries]
+    assert kinds.count("preemption") == 1
+    assert kinds.count("resumed") == 1
+    assert kinds[0] == "created"
+    # commit order IS causal order: seq strictly increasing
+    seqs = [e["seq"] for e in entries]
+    assert seqs == sorted(seqs)
+    retry = next(e for e in entries if e["label"].startswith("-> retrying"))
+    assert "Preempted" in retry["label"]
+    assert entries[-1]["label"] == "-> succeeded"
+    resumed = next(e for e in entries if e["kind"] == "resumed")
+    assert resumed["label"] == "resumed at step 100 from local tier"
+
+
+# --------------------------------------------- streams server surfaces
+
+
+def test_streams_timeline_endpoint(tmp_path):
+    from polyaxon_tpu.streams.server import BackgroundServer
+
+    store = RunStore(tmp_path / "store")
+    _drive_preempted_run(store)
+    with BackgroundServer(store) as srv:
+        url = f"http://127.0.0.1:{srv.port}/runs/{RUN}/timeline"
+        with urllib.request.urlopen(url) as r:
+            body = json.loads(r.read())
+    assert body["uuid"] == RUN
+    assert [e["kind"] for e in body["timeline"]].count("preemption") == 1
+
+
+def test_streams_metricsz_federates_siblings(tmp_path):
+    from polyaxon_tpu.streams.server import BackgroundServer
+
+    store = RunStore(tmp_path / "store")
+    with BackgroundServer(store) as sibling:
+        sources = {
+            "agent": f"http://127.0.0.1:{sibling.port}",
+            "ghost": "http://127.0.0.1:9",  # discard port: always down
+        }
+        with BackgroundServer(store, federate=sources) as srv:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metricsz"
+            ) as r:
+                snap = parse_prometheus_text(r.read().decode())
+    assert snap.get("federation_source_up", source="agent") == 1.0
+    assert snap.get("federation_source_up", source="ghost") == 0.0
+    # the sibling's series carry their source identity
+    assert any(s.labels.get("source") == "agent" for s in snap)
+
+
+# ----------------------------------------------------------- CLI views
+
+
+def test_cli_timeline_renders_story(tmp_home):
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+
+    _drive_preempted_run(RunStore())
+    res = CliRunner().invoke(cli, ["timeline", RUN])
+    assert res.exit_code == 0, res.output
+    assert "preempted (step 120, resume at 100)" in res.output
+    assert "resumed at step 100 from local tier" in res.output
+    assert "-> succeeded" in res.output
+
+    res = CliRunner().invoke(cli, ["timeline", RUN, "--json"])
+    assert res.exit_code == 0, res.output
+    rows = [json.loads(line) for line in res.output.splitlines() if line]
+    assert [r["kind"] for r in rows].count("preemption") == 1
+
+
+def test_cli_timeline_unknown_run_is_clean_error(tmp_home):
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+
+    res = CliRunner().invoke(cli, ["timeline", "nope"])
+    assert res.exit_code != 0
+    assert "Traceback" not in res.output
+
+
+def test_top_once_frame_offline_router(tmp_path):
+    """One --once frame over a dead router URL: the store pane still
+    renders (runs seeded from the event log, zero scans), the router
+    pane degrades to 'unreachable'."""
+    from polyaxon_tpu.cli.top import run_top
+
+    store = RunStore(tmp_path / "store")
+    _drive_preempted_run(store)
+    store.create_run("bb" * 8, "live-run", "proj", {"op": 1})
+    before = store.scans
+    out = io.StringIO()
+    run_top(store, "http://127.0.0.1:9", once=True, out=out)
+    frame = out.getvalue()
+    assert store.scans == before
+    assert "router   unreachable" in frame
+    assert "succeeded:1" in frame
+    assert "created:1" in frame
+    assert "live-run" in frame  # active run listed; finished one is not
+    assert "trainer-1" not in frame
+    assert "\x1b[" not in frame  # --once is pipe-friendly: no ANSI
+
+
+def test_top_frame_renders_cluster_and_slo_blocks():
+    from polyaxon_tpu.cli.top import _RunTable, render_frame
+
+    stats = {
+        "requests": 40, "retries": 2, "upstream_shed": 1, "errors": 0,
+        "routable": 2,
+        "latency_ms": {"p95": 12.5},
+        "cluster": {
+            "federation": True, "queue_depth": 3.0, "inflight": 2,
+            "queue_wait_ms_max": 8.0, "serving_requests": 44.0,
+            "serving_shed": 1.0,
+        },
+        "replicas": [
+            {"slug": "r0", "healthy": True, "draining": False,
+             "queue_depth": 1, "queue_wait_ms": 4.0, "inflight": 1,
+             "requests": 22},
+            {"slug": "r1", "healthy": False, "draining": False,
+             "queue_depth": None, "queue_wait_ms": None, "inflight": 0,
+             "requests": 18},
+        ],
+    }
+    slo = {"slos": [
+        {"name": "p95-latency", "burn_rate": 2.41, "breached": True},
+    ]}
+    frame = render_frame(
+        url="http://x", fleet=None, stats=stats, slo=slo, runs=_RunTable()
+    )
+    assert "cluster  queue 3" in frame
+    assert "r0" in frame and "r1" in frame and "down" in frame
+    assert "p95-latency burn 2.41 BREACHED" in frame
