@@ -83,6 +83,20 @@ records:
    "p50_direct_ms": ..., "p50_router_ms": ..., "p95_direct_ms": ...,
    "p95_router_ms": ..., "byte_identical": true}
 
+`--interference` runs the ISSUE 14 chunked-prefill record: one long-
+prompt/long-decode request per round with a burst of short streamed
+requests fired while it is in flight, against an unchunked paged server
+(one blocking execute per group — shorts wait out the whole long
+request) and the chunked step scheduler (`chunkedPrefill: true` — the
+long prefill is sliced and the shorts' chunks/decode rows share device
+steps). Pins short-request TTFT both ways; the ≥2× smoke gate follows
+the router-scaling precedent (`gate_enforced` only with ≥2 cores):
+
+  {"metric": "serving_interference_ttft_speedup", "value": ..., "unit":
+   "x", "ttft_short_p95_unchunked_ms": ..., "ttft_short_p95_chunked_ms":
+   ..., "long_total_p50_chunked_ms": ..., "prefill_chunks": ...,
+   "host_cores": C, "gate_enforced": bool}
+
 Aggregate scaling needs real parallel compute: replicas are separate
 processes, so the ≥1.7× smoke gate at 2 replicas is enforced only when
 the host has ≥2 usable cores (`gate_enforced`); on a 1-core host the
@@ -99,6 +113,7 @@ are core-independent and always enforced in --smoke.
   python benchmarks/serving_bench.py --speculate     # fast-decode demo
   python benchmarks/serving_bench.py --trace-overhead # tracing cost
   python benchmarks/serving_bench.py --federation-overhead # plane cost
+  python benchmarks/serving_bench.py --interference  # chunked prefill
   python benchmarks/serving_bench.py --smoke --router --replicas 2
 """
 
@@ -162,7 +177,10 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float,
                  kv_pool_pages: int | None = None,
                  kv_page_tokens: int = 16,
                  stream_chunk_tokens: int = 4,
-                 trace: bool = True):
+                 trace: bool = True,
+                 chunked_prefill: bool = False,
+                 prefill_chunk_tokens: int = 64,
+                 max_step_tokens: int = 256):
     import jax
     import jax.numpy as jnp
 
@@ -184,6 +202,9 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float,
             batching=batching, max_batch=max_batch, max_wait_ms=max_wait_ms,
             kv_pool_pages=kv_pool_pages, kv_page_tokens=kv_page_tokens,
             stream_chunk_tokens=stream_chunk_tokens, trace=trace,
+            chunked_prefill=chunked_prefill,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            max_step_tokens=max_step_tokens,
         ),
     )
 
@@ -718,6 +739,132 @@ def drive_fast_decode(requests: int, draft_tokens: int,
     return recs
 
 
+def drive_interference(rounds: int, shorts_per_round: int, max_batch: int,
+                       max_wait_ms: float, kv_pool_pages: int, seed: int,
+                       prefill_chunk_tokens: int = 16,
+                       max_step_tokens: int = 64) -> dict:
+    """ISSUE 14 record: head-of-line blocking under a mixed-length mix.
+
+    Each round posts one long-prompt/long-decode request and then, while
+    it is still in flight, a burst of short streamed requests. On the
+    unchunked paged server the worker runs the long request as one
+    blocking execute, so every short request's first token waits for the
+    long request to finish. On the chunked server the step scheduler
+    slices the long prefill and packs the shorts' chunks and decode rows
+    into the same device steps — short TTFT stops scaling with the long
+    request's length. The record pins short-request ttft_p95 both ways:
+
+      {"metric": "serving_interference_ttft_speedup", "value": ...,
+       "unit": "x", "ttft_short_p95_unchunked_ms": ...,
+       "ttft_short_p95_chunked_ms": ..., "host_cores": C,
+       "gate_enforced": bool}
+
+    Like router scaling (PR 10), the gate needs real parallelism: the
+    client threads that time TTFT and the server's step loop contend for
+    CPU on a 1-core host, burying the scheduling win under scheduler
+    noise — the ≥2x smoke gate is enforced only when `gate_enforced`.
+    """
+    import os
+
+    import jax
+
+    rng = random.Random(seed)
+    long_len, short_len = 96, 8
+    vocab = MODEL_CFG["vocab_size"]
+    long_prompt = [rng.randrange(vocab) for _ in range(long_len)]
+    short_prompts = [
+        [rng.randrange(vocab) for _ in range(short_len)]
+        for _ in range(rounds * shorts_per_round)
+    ]
+
+    def body(tokens: list[int], new: int, s: int) -> dict:
+        return {"tokens": [tokens], "maxNewTokens": new,
+                "temperature": 0.8, "topK": 40, "seed": s}
+
+    sides = {}
+    stats = {}
+    for label, chunked in (("unchunked", False), ("chunked", True)):
+        srv = build_server(
+            True, max_batch, max_wait_ms, kv_pool_pages=kv_pool_pages,
+            chunked_prefill=chunked,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            max_step_tokens=max_step_tokens,
+        )
+        port = srv.start(port=0)
+        url = f"http://127.0.0.1:{port}/generate"
+        try:
+            # warm both shapes so compiles never land in a timed round
+            _post(url, body(long_prompt, 32, 0))
+            _stream_ttft("127.0.0.1", port, body(short_prompts[0], 4, 0))
+
+            ttfts: list[float] = []
+            longs: list[float] = []
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                done = threading.Event()
+
+                def fire_long():
+                    _post(url, body(long_prompt, 32, 100 + r))
+                    longs.append(time.perf_counter() - t0)
+                    done.set()
+
+                t = threading.Thread(target=fire_long, daemon=True)
+                t.start()
+                time.sleep(0.01)  # let the long request enter the worker
+                for i in range(shorts_per_round):
+                    ttft, _ = _stream_ttft(
+                        "127.0.0.1", port,
+                        body(short_prompts[r * shorts_per_round + i], 4,
+                             200 + r * shorts_per_round + i),
+                    )
+                    ttfts.append(ttft * 1000.0)
+                done.wait(timeout=300.0)
+            sides[label] = ttfts
+            stats[label] = {
+                "long_total_p50_ms": round(quantile(longs, 0.5) * 1000, 1),
+                **json.loads(
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/statsz", timeout=30
+                    ).read()
+                ).get("chunked", {}),
+            }
+        finally:
+            srv.stop()
+
+    p95_un = quantile(sides["unchunked"], 0.95)
+    p95_ch = quantile(sides["chunked"], 0.95)
+    cores = len(os.sched_getaffinity(0))
+    device = jax.devices()[0]
+    return {
+        "metric": "serving_interference_ttft_speedup",
+        "value": round(p95_un / p95_ch, 2) if p95_ch else None,
+        "unit": "x",
+        "ttft_short_p50_unchunked_ms": round(
+            quantile(sides["unchunked"], 0.5), 1),
+        "ttft_short_p50_chunked_ms": round(
+            quantile(sides["chunked"], 0.5), 1),
+        "ttft_short_p95_unchunked_ms": round(p95_un, 1),
+        "ttft_short_p95_chunked_ms": round(p95_ch, 1),
+        "long_total_p50_unchunked_ms":
+            stats["unchunked"]["long_total_p50_ms"],
+        "long_total_p50_chunked_ms": stats["chunked"]["long_total_p50_ms"],
+        "long_prompt_tokens": long_len,
+        "short_prompt_tokens": short_len,
+        "short_requests": len(sides["chunked"]),
+        "prefill_chunk_tokens": prefill_chunk_tokens,
+        "max_step_tokens": max_step_tokens,
+        "steps": stats["chunked"].get("steps", 0),
+        "prefill_chunks": stats["chunked"].get("prefill_chunks", 0),
+        "host_cores": cores,
+        # 1-core hosts bury the scheduling win under CPU contention
+        # between the timing clients and the step loop (see router
+        # scaling) — report honestly, gate only where it can express
+        "gate_enforced": cores >= 2,
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+
+
 def serve_replica(port: int, max_batch: int, max_wait_ms: float) -> int:
     """`--serve-replica` self-mode: one replica process. Every replica
     builds the SAME model from PRNGKey(0), so responses are
@@ -981,6 +1128,10 @@ def main(argv=None):
                     help="run the ISSUE 13 observability-plane record "
                          "(router with stitching+federation on vs off, "
                          "min-of-repeats) instead of the traffic sweep")
+    ap.add_argument("--interference", action="store_true",
+                    help="run the ISSUE 14 chunked-prefill record: short-"
+                         "request TTFT under a long-prompt mix, chunked "
+                         "step scheduler vs one-blocking-execute")
     ap.add_argument("--router", action="store_true",
                     help="run the ISSUE 10 horizontal-serving records "
                          "(replica processes behind serving/router.py) "
@@ -1023,6 +1174,20 @@ def main(argv=None):
                 ok = False
             if scale["gate_enforced"] and (scale["value"] or 0) < 1.7:
                 ok = False
+        return 0 if ok else 1
+
+    if args.interference:
+        rounds, shorts = (2, 3) if args.smoke else (4, 4)
+        rec = drive_interference(
+            rounds, shorts, args.max_batch, args.max_wait_ms,
+            args.kv_pool_pages, args.seed,
+        )
+        print(json.dumps(rec), flush=True)
+        # the record must show the step scheduler actually ran (chunks
+        # landed); the >=2x TTFT gate needs cores the host may not have
+        ok = rec["prefill_chunks"] > 0 and rec["steps"] > 0
+        if args.smoke and rec["gate_enforced"] and (rec["value"] or 0) < 2.0:
+            ok = False
         return 0 if ok else 1
 
     if args.shared_prefix:

@@ -796,6 +796,19 @@ def agent_drain(queues):
               help="int8 weight-only quantize the projection kernels at "
                    "load (per-output-channel scales; prefill/embed/lm_head "
                    "stay full precision)")
+@click.option("--chunked-prefill", is_flag=True,
+              help="slice prompt prefill into bounded chunks interleaved "
+                   "with decode steps so short requests are not stuck "
+                   "behind long prompts (requires --kv-pool-pages)")
+@click.option("--no-chunked-prefill", is_flag=True,
+              help="force chunked prefill off even when the run spec "
+                   "pins chunkedPrefill: true")
+@click.option("--prefill-chunk-tokens", default=None, type=int,
+              help="prompt tokens prefilled per device step when chunked "
+                   "prefill is on (default 64)")
+@click.option("--max-step-tokens", default=None, type=int,
+              help="token budget one device step may touch: all decode "
+                   "rows plus at most one prefill slice (default 256)")
 @click.option("--no-trace", is_flag=True,
               help="disable per-request tracing (/tracez and X-Request-Id "
                    "correlation stay, but no span timelines are recorded)")
@@ -816,8 +829,9 @@ def agent_drain(queues):
 def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
           expected_devices, kv_pool_pages, kv_page_tokens, no_prefix_cache,
-          no_stream, speculate, draft_tokens, quantize, no_trace,
-          replicas, mesh_model, route, autoscale_max):
+          no_stream, speculate, draft_tokens, quantize, chunked_prefill,
+          no_chunked_prefill, prefill_chunk_tokens, max_step_tokens,
+          no_trace, replicas, mesh_model, route, autoscale_max):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
@@ -858,6 +872,14 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         overrides["speculate"] = True
     if quantize:
         overrides["quantize"] = True
+    if chunked_prefill and no_chunked_prefill:
+        raise click.ClickException(
+            "--chunked-prefill and --no-chunked-prefill are exclusive"
+        )
+    if chunked_prefill:
+        overrides["chunked_prefill"] = True
+    if no_chunked_prefill:
+        overrides["chunked_prefill"] = False
     if no_trace:
         overrides["trace"] = False
     for field, value in (
@@ -870,6 +892,8 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         ("kv_pool_pages", kv_pool_pages),
         ("kv_page_tokens", kv_page_tokens),
         ("draft_tokens", draft_tokens),
+        ("prefill_chunk_tokens", prefill_chunk_tokens),
+        ("max_step_tokens", max_step_tokens),
     ):
         if value is not None:
             overrides[field] = value
@@ -934,6 +958,8 @@ _SERVE_FLAG_SPELLING = {
     "kv_pool_pages": "--kv-pool-pages",
     "kv_page_tokens": "--kv-page-tokens",
     "draft_tokens": "--draft-tokens",
+    "prefill_chunk_tokens": "--prefill-chunk-tokens",
+    "max_step_tokens": "--max-step-tokens",
 }
 
 
@@ -960,6 +986,8 @@ def _serve_child_argv(uid, port, mesh_axes, overrides, expected_devices):
             argv += ["--no-trace"]
         elif field in ("speculate", "quantize") and value:
             argv += [f"--{field}"]
+        elif field == "chunked_prefill":
+            argv += ["--chunked-prefill" if value else "--no-chunked-prefill"]
         elif field in _SERVE_FLAG_SPELLING:
             argv += [_SERVE_FLAG_SPELLING[field], str(value)]
     return argv

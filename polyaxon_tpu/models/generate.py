@@ -378,6 +378,183 @@ def jit_paged_chunk(
     return jax.jit(run, donate_argnums=(1,))
 
 
+# ----------------------------------------------------- chunked prefill (ISSUE 14)
+# The step scheduler slices a row's prefill into `prefill_chunk_tokens`-
+# sized pieces and interleaves them with ongoing decode steps, so a long
+# prompt never monopolizes the decode worker. Two additional programs:
+#
+#  * `jit_paged_prefill_chunk` — one slice of the LEFT-padded suffix
+#    written through the page table at a traced start slot. The FINAL
+#    slice's last-position logits sample the first token exactly like
+#    one-shot `jit_paged_prefill` (same fold_in(key, 0) stream), so the
+#    prefill boundary is byte-identical however the prompt was sliced.
+#  * `jit_paged_step` — ONE decode step for a batch of rows at per-row
+#    write frontiers / generation indices / prefix widths. Rows that
+#    joined the batch mid-flight (continuous batching) sample from their
+#    own fold_in(key, g) streams, so batch composition never changes a
+#    row's tokens.
+#
+# Both take the prefix width as a traced [B] argument (`prefix_lens`)
+# instead of a compile-time constant: rows with different cached-prefix
+# lengths share one compiled program, which is what lets arbitrary rows
+# pack into one step. COW safety is inherited from the paged layout —
+# chunk writes only ever target slots >= the row's prefix width, so
+# shared prefix pages stay read-only.
+
+
+def paged_prefill_chunk(
+    module,
+    params,
+    cache,
+    chunk: jnp.ndarray,
+    *,
+    pad,
+    pages,
+    kv_layout: PagedKVLayout,
+    prefix_lens,
+    pos,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    seeds=None,
+    final: bool = False,
+) -> tuple:
+    """Write one prefill slice `chunk` [B, C] (columns [pos-prefix, ...)
+    of the row's LEFT-padded suffix) into the page tables at slots
+    [pos, pos + C). Non-final slices only fill KV (the lm_head matmul is
+    skipped via return_features); the final slice samples the first new
+    token per row at generation index 0 — byte-identical to one-shot
+    `paged_prefill` because the last chunk's last position IS the same
+    query the one-shot program sampled from. Returns cache' (non-final)
+    or (cache', first_tokens [B]) (final)."""
+    kwargs = dict(
+        train=False,
+        decode=True,
+        mutable=["cache"],
+        pad=pad,
+        pages=pages,
+        pos=jnp.asarray(pos, jnp.int32),
+        kv_layout=kv_layout,
+        prefix_lens=jnp.asarray(prefix_lens, jnp.int32),
+    )
+    if not final:
+        _, vars1 = module.apply(
+            {"params": params, "cache": cache},
+            chunk.astype(jnp.int32),
+            return_features=True,  # KV writes only — skip the vocab matmul
+            **kwargs,
+        )
+        return vars1["cache"]
+    logits, vars1 = module.apply(
+        {"params": params, "cache": cache}, chunk.astype(jnp.int32), **kwargs
+    )
+    row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
+    first = _sample_rows(
+        logits[:, -1].astype(jnp.float32),
+        _row_rngs(row_keys, 0),
+        temperature,
+        top_k,
+    )
+    return vars1["cache"], first
+
+
+def jit_paged_prefill_chunk(
+    module,
+    *,
+    kv_layout: PagedKVLayout,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    final: bool = False,
+):
+    """Compiled prefill slice: (params, cache, chunk, pad, prefix_lens,
+    pages, seeds, pos) → cache' (non-final) or (cache', first) (final).
+    Cache DONATED; pos is a traced scalar and prefix_lens a traced [B]
+    vector, so every slice of every row — whatever its cached-prefix
+    width — reuses one compile per (B, C, n_pages) shape."""
+
+    def run(params, cache, chunk, pad, prefix_lens, pages, seeds, pos):
+        return paged_prefill_chunk(
+            module, params, cache, chunk,
+            pad=pad, pages=pages, kv_layout=kv_layout,
+            prefix_lens=prefix_lens, pos=pos,
+            temperature=temperature, top_k=top_k, seeds=seeds, final=final,
+        )
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+def paged_step(
+    module,
+    params,
+    cache,
+    tok,
+    done,
+    *,
+    pad,
+    prefix_lens,
+    pages,
+    kv_layout: PagedKVLayout,
+    pos,
+    g,
+    seeds,
+    temperature: float,
+    top_k: Optional[int],
+    eos_id: Optional[int],
+) -> tuple:
+    """ONE decode step for a continuous batch: feed `tok` [B] at per-row
+    frontiers `pos` [B] and sample each row's next token at its own
+    generation index `g` [B]. Identical math to one iteration of
+    `paged_decode_chunk`'s scan body — same fold_in(key, g) streams,
+    same eos latch — just with pos/g/prefix as per-row runtime vectors
+    so rows of different ages and prefix widths share the dispatch.
+    Returns (cache', nxt [B], done' [B])."""
+    logits, out_vars = module.apply(
+        {"params": params, "cache": cache},
+        jnp.asarray(tok, jnp.int32)[:, None],
+        train=False,
+        decode=True,
+        mutable=["cache"],
+        pad=pad,
+        pages=pages,
+        pos=jnp.asarray(pos, jnp.int32),
+        kv_layout=kv_layout,
+        prefix_lens=jnp.asarray(prefix_lens, jnp.int32),
+    )
+    row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
+    rngs = jax.vmap(jax.random.fold_in)(row_keys, jnp.asarray(g, jnp.int32))
+    nxt = _sample_rows(
+        logits[:, -1].astype(jnp.float32), rngs, temperature, top_k
+    )
+    if eos_id is not None:
+        done = done | (jnp.asarray(tok, jnp.int32) == eos_id)
+        nxt = jnp.where(done, eos_id, nxt)
+    return out_vars["cache"], nxt, done
+
+
+def jit_paged_step(
+    module,
+    *,
+    kv_layout: PagedKVLayout,
+    temperature: float,
+    top_k: Optional[int],
+    eos_id: Optional[int],
+):
+    """Compiled continuous-batching decode step: (params, cache, tok,
+    done, pad, prefix_lens, pages, seeds, pos, g) → (cache', nxt,
+    done'). Cache DONATED; every traced argument is per-row, so one
+    compile per (B, n_pages, sampling) signature serves the whole mixed
+    step stream."""
+
+    def run(params, cache, tok, done, pad, prefix_lens, pages, seeds, pos, g):
+        return paged_step(
+            module, params, cache, tok, done,
+            pad=pad, prefix_lens=prefix_lens, pages=pages,
+            kv_layout=kv_layout, pos=pos, g=g, seeds=seeds,
+            temperature=temperature, top_k=top_k, eos_id=eos_id,
+        )
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
 def beam_search(
     module,
     params,

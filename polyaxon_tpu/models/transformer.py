@@ -175,6 +175,10 @@ class Attention(nn.Module):
         kv_layout=None,  # kv_pages.PagedKVLayout (static pool shape)
         prefix_len: int = 0,  # static: slots [0, prefix_len) hold a shared
         # prefilled prefix; the row's own tokens start (left-padded) after it
+        prefix_lens=None,  # traced [B] per-row prefix widths — the step
+        # scheduler (ISSUE 14) packs rows with DIFFERENT cached-prefix
+        # lengths into one compiled program, so the mask's prefix boundary
+        # must be a runtime argument there; overrides `prefix_len`
     ):
         cfg = self.cfg
         B, S, _ = x.shape
@@ -347,7 +351,17 @@ class Attention(nn.Module):
                 )
                 mask = live[:, None, :, :]
                 if pad is not None:
-                    if prefix_len:
+                    if prefix_lens is not None:
+                        # per-row traced prefix boundary (step scheduler):
+                        # same [prefix | dead pad | own] layout as the
+                        # static branch below, with the boundary broadcast
+                        # per row. prefix_lens[b] == 0 degrades to the
+                        # plain left-pad mask, so one compiled program
+                        # serves warm and cold rows alike.
+                        ar = jnp.arange(win)[None, :]
+                        pl = prefix_lens[:, None]
+                        valid = (ar < pl) | (ar >= pl + pad[:, None])
+                    elif prefix_len:
                         # row layout: [shared prefix 0..prefix_len) |
                         # dead left-pad | own tokens]. Prefix slots are
                         # live for every row; the dead window shifts right.
@@ -421,7 +435,7 @@ class Block(nn.Module):
     prefix_len: int = 0
 
     @nn.compact
-    def __call__(self, x, pad=None, pages=None, pos=None):
+    def __call__(self, x, pad=None, pages=None, pos=None, prefix_lens=None):
         from ..parallel.sharding import constrain
 
         cfg = self.cfg
@@ -435,6 +449,7 @@ class Block(nn.Module):
             pos=pos,
             kv_layout=self.kv_layout,
             prefix_len=self.prefix_len,
+            prefix_lens=prefix_lens,
         )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
@@ -481,6 +496,15 @@ class _ScanBlock(nn.Module):
             name="block",
         )
         if isinstance(carry, tuple):
+            if len(carry) == 5:
+                x, pad, pages, pos, prefix_lens = carry
+                return (
+                    block(
+                        x, pad=pad, pages=pages, pos=pos,
+                        prefix_lens=prefix_lens,
+                    ),
+                    pad, pages, pos, prefix_lens,
+                ), None
             if len(carry) == 4:
                 x, pad, pages, pos = carry
                 return (block(x, pad=pad, pages=pages, pos=pos), pad, pages, pos), None
@@ -565,6 +589,8 @@ class Transformer(nn.Module):
         # frontiers): first cache slot written this call
         kv_layout=None,  # kv_pages.PagedKVLayout (static pool shape)
         prefix_len: int = 0,  # static shared-prefix width (paged path)
+        prefix_lens=None,  # traced [B] per-row prefix widths (step
+        # scheduler mixed-prefix programs); overrides prefix_len
     ):
         cfg = self.cfg
         if decode and cfg.pipeline_stages > 1:
@@ -615,7 +641,14 @@ class Transformer(nn.Module):
                 cfg, train, decode,
                 kv_layout=kv_layout, prefix_len=prefix_len, name="layers",
             )
-            if pages is not None or pos is not None:
+            if prefix_lens is not None:
+                # 5-tuple carry: the traced per-row prefix widths ride
+                # alongside pad/pages/pos (step-scheduler programs only,
+                # so the legacy 4-tuple carry keeps its compiled identity)
+                (x, _, _, _, _), _ = layers(
+                    (x, pad, pages, pos, prefix_lens), None
+                )
+            elif pages is not None or pos is not None:
                 # pos rides the 4-tuple carry on the dense speculative
                 # path too (pages is then a None leafless subtree)
                 (x, _, _, _), _ = layers((x, pad, pages, pos), None)
@@ -629,7 +662,7 @@ class Transformer(nn.Module):
                     cfg, train, decode,
                     kv_layout=kv_layout, prefix_len=prefix_len,
                     name=f"layer_{i}",
-                )(x, pad=pad, pages=pages, pos=pos)
+                )(x, pad=pad, pages=pages, pos=pos, prefix_lens=prefix_lens)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_features:
             # fused-loss path: the caller computes head+loss from features;

@@ -163,6 +163,14 @@ class V1ServingSpec(BaseSchema):
     speculate: bool = False
     draft_tokens: int | str = 4
     quantize: bool = False
+    # chunked prefill + step scheduling (ISSUE 14): chunkedPrefill slices
+    # prefill into prefillChunkTokens-wide device steps interleaved with
+    # decode (kills head-of-line blocking behind long prompts; requires
+    # kvPoolPages), and maxStepTokens bounds the tokens any single step
+    # may touch — the admission token budget
+    chunked_prefill: bool = False
+    prefill_chunk_tokens: int | str = 64
+    max_step_tokens: int | str = 256
     # horizontal serving (ISSUE 10): replicas is the fleet width (N
     # gang-placed ModelServer processes behind serving/router.py);
     # meshAxes is the per-replica decode mesh, e.g. {"batch": 2,
@@ -229,6 +237,27 @@ class V1ServingSpec(BaseSchema):
             )
         if isinstance(self.max_queue, int) and self.max_queue < 1:
             raise ValueError(f"maxQueue must be >= 1, got {self.max_queue}")
+        if (
+            isinstance(self.prefill_chunk_tokens, int)
+            and self.prefill_chunk_tokens < 1
+        ):
+            raise ValueError(
+                f"prefillChunkTokens must be >= 1, "
+                f"got {self.prefill_chunk_tokens}"
+            )
+        if isinstance(self.max_step_tokens, int) and self.max_step_tokens < 1:
+            raise ValueError(
+                f"maxStepTokens must be >= 1, got {self.max_step_tokens}"
+            )
+        if (
+            self.chunked_prefill
+            and self.kv_pool_pages is None
+        ):
+            raise ValueError(
+                "chunkedPrefill requires the paged KV pool — set "
+                "kvPoolPages (page tables are what let a half-prefilled "
+                "row persist across device steps)"
+            )
         if isinstance(self.breaker_threshold, int) and self.breaker_threshold < 1:
             raise ValueError(
                 f"breakerThreshold must be >= 1, got {self.breaker_threshold}"
@@ -288,6 +317,9 @@ class V1ServingSpec(BaseSchema):
             speculate=self.speculate,
             draft_tokens=int(self.draft_tokens),
             quantize=self.quantize,
+            chunked_prefill=self.chunked_prefill,
+            prefill_chunk_tokens=int(self.prefill_chunk_tokens),
+            max_step_tokens=int(self.max_step_tokens),
             mesh_axes=normalize_mesh_axes(
                 {ax: int(n) for ax, n in self.mesh_axes.items()}
                 if self.mesh_axes is not None

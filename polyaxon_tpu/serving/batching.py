@@ -202,6 +202,16 @@ class ServingConfig:
     # of compile-cache identity; None = single-chip (pre-mesh behaviour).
     # Only `batch`/`model` are legal (parallel.mesh.DECODE_AXES).
     mesh_axes: Optional[tuple[tuple[str, int], ...]] = None
+    # chunked prefill + step scheduling (ISSUE 14): slice prefill into
+    # prefill_chunk_tokens-wide device steps interleaved with decode so a
+    # long prompt cannot monopolize the worker (head-of-line blocking).
+    # max_step_tokens bounds the tokens any single device step may touch
+    # (all decode rows + at most one prefill slice) — the admission
+    # budget. Requires the paged KV path (kv_pool_pages); the dense path
+    # ignores these and keeps the classic group coalescer.
+    chunked_prefill: bool = False
+    prefill_chunk_tokens: int = 64
+    max_step_tokens: int = 256
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -654,15 +664,32 @@ class DecodeCoalescer:
             batch = [r for r in self._pending if r.key == head.key][
                 : self.max_batch
             ]
-            deadline = head.enqueued_at + self.max_wait
             now = time.monotonic()
+            # ISSUE 14 satellite: the flush deadline used to come from the
+            # head request only, so an expired NON-head row sat in its slot
+            # until the group flushed — and only then 504'd, after the
+            # group's tokens were already spent around it. Cap the wait at
+            # the earliest pending deadline so the purge above runs the
+            # moment any row expires, extending the PR 5 "dropped BEFORE
+            # spending a decode slot" contract to mid-group.
+            dmin = min(
+                (r.deadline for r in self._pending if r.deadline is not None),
+                default=None,
+            )
+            if dmin is not None and dmin <= now:
+                self._purge_expired()
+                continue
+            deadline = head.enqueued_at + self.max_wait
+            if dmin is not None:
+                deadline = min(deadline, dmin)
             if (
                 len(batch) < self.max_batch
                 and now < deadline
                 and alive
                 and not self._draining.is_set()
             ):
-                # wait (bounded by the head's age) for coalescable arrivals
+                # wait (bounded by the head's age AND the earliest pending
+                # deadline) for coalescable arrivals
                 alive = self._drain_into_pending(timeout=deadline - now)
                 continue
             for r in batch:

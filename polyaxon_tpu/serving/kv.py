@@ -270,7 +270,16 @@ class KVCacheManager:
 
     def tables(self, plans, batch: int, n_pages: int):
         """[batch, n_pages] int32 page tables: prefix + own pages per real
-        row, scratch everywhere else (unallocated tails, dummy rows)."""
+        row, scratch everywhere else (unallocated tails, dummy rows).
+
+        The scratch tail is load-bearing for chunked prefill (ISSUE 14):
+        the step engine requests tables WIDER than a row's allocated
+        pages (the next power of two over its final page count, so one
+        compiled program serves every chunk). Slots past the row's
+        frontier are masked by `prompt_lengths`/position math inside the
+        programs, so writes land in the scratch page and reads never
+        reach it — any other fill value here would silently break the
+        chunked ≡ one-shot byte-identity pin."""
         import numpy as np
 
         t = np.full((batch, n_pages), self.scratch, np.int32)

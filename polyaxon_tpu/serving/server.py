@@ -360,6 +360,25 @@ class ModelServer:
             help="Time to first token, milliseconds (admission → first "
             "sampled token; whole-decode on the dense path)",
         )
+        # chunked prefill + step scheduling series (ISSUE 14) — registered
+        # from startup (zeros when chunking is off) so the canary's
+        # chunked-prefill gate can scrape them unconditionally
+        self._m_prefill_chunks = self.telemetry.counter(
+            "serving.prefill_chunks",
+            help="Prefill slices executed by the step scheduler "
+            "(chunked prefill)",
+        )
+        self._m_step_tokens = self.telemetry.histogram(
+            "serving.step_tokens",
+            buckets=(8, 16, 32, 64, 128, 256, 512, 1024),
+            help="Tokens touched per device step (all decode rows plus at "
+            "most one prefill slice; bounded by maxStepTokens)",
+        )
+        self._m_prefill_queue = self.telemetry.gauge(
+            "serving.prefill_queue_depth",
+            help="Rows admitted but not yet past prefill (pending + "
+            "mid-prefill), refreshed at scrape time",
+        )
         # per-request tracing (ISSUE 9): HTTP-level availability counters
         # (request attempts and 5xx-class failures — the SLO engine's
         # availability numerator/denominator), the tail-sampling trace
@@ -450,6 +469,25 @@ class ModelServer:
             cooldown_s=self.config.breaker_cooldown_s,
             on_change=self._m_breaker.set,
         )
+        if self.config.chunked_prefill and self.config.kv_pool_pages:
+            # chunked prefill + token-budget step loop (ISSUE 14): only
+            # meaningful on the paged path — page tables are what let a
+            # half-prefilled row persist across steps. The classic
+            # _dispatch_group stays as the blocking fallback for rows the
+            # engine cannot step (beam search).
+            from .steps import StepScheduler
+
+            return StepScheduler(
+                self._dispatch_group,
+                _StepEngine(self),
+                prefill_chunk_tokens=self.config.prefill_chunk_tokens,
+                max_step_tokens=self.config.max_step_tokens,
+                max_batch=self.config.max_batch,
+                max_wait_ms=self.config.max_wait_ms,
+                max_queue=self.config.max_queue,
+                breaker=breaker,
+                observer=self._observe,
+            )
         return DecodeCoalescer(
             self._dispatch_group,
             max_batch=self.config.max_batch,
@@ -479,6 +517,15 @@ class ModelServer:
             self.telemetry.counter(
                 "serving.decode_errors", help="Decode batch failures"
             ).inc()
+        elif event == "step":
+            # one device step of the step scheduler: its token budget
+            # spend and its row occupancy (same histogram the classic
+            # group path feeds, so occupancy dashboards keep working)
+            self._m_step_tokens.observe(float(ctx.get("tokens", 0)))
+            rows = int(ctx.get("rows", 0))
+            if rows:
+                self._m_occupancy.observe(rows)
+            self._m_batches.inc()
 
     def _kv_observe(self, event: str, **ctx) -> None:
         """KVCacheManager → registry bridge (same pipeline as _observe)."""
@@ -1373,6 +1420,46 @@ class ModelServer:
             ),
         )
 
+    def _prefill_chunk_fn(self, final, temperature, top_k):
+        """Chunked-prefill slice program (ISSUE 14). pos/prefix_lens/pad
+        are traced and jit re-specializes per chunk width internally, so
+        ONE cache entry per (final, sampling) signature serves every
+        prefix length, bucket, and slice of every request."""
+        from ..models.generate import jit_paged_prefill_chunk
+
+        if not final:
+            temperature, top_k = 0.0, None  # non-final slices never sample
+        key = ("prefill_chunk", final, temperature, top_k)
+        return self._cached(
+            key,
+            lambda: jit_paged_prefill_chunk(
+                self.module,
+                kv_layout=self._kv.layout,
+                temperature=temperature,
+                top_k=top_k,
+                final=final,
+            ),
+        )
+
+    def _paged_step_fn(self, temperature, top_k, eos_id):
+        """Unified single-step decode program (ISSUE 14): per-row pos/g/
+        prefix_lens are traced, so every plain paged row — whatever its
+        buckets or cached prefix — shares one cache entry per sampling
+        signature."""
+        from ..models.generate import jit_paged_step
+
+        key = ("paged_step", temperature, top_k, eos_id)
+        return self._cached(
+            key,
+            lambda: jit_paged_step(
+                self.module,
+                kv_layout=self._kv.layout,
+                temperature=temperature,
+                top_k=top_k,
+                eos_id=eos_id,
+            ),
+        )
+
     def _execute_group_paged(self, batch: list[PendingRequest]):
         """Paged decode for one coalesced group: prefill the suffixes
         through the page tables (the shared prefix is already in the
@@ -1919,6 +2006,24 @@ class ModelServer:
             "enabled": bool(self.config.quantize),
             "bytes_saved": int(self._quant_bytes_saved),
         }
+        chunked = {"enabled": False}
+        c = self._coalescer
+        if c is not None and hasattr(c, "steps_run"):
+            st = self._m_step_tokens.summary()
+            chunked = {
+                "enabled": True,
+                "prefill_chunk_tokens": int(self.config.prefill_chunk_tokens),
+                "max_step_tokens": int(self.config.max_step_tokens),
+                "steps": c.steps_run,
+                "prefill_only_steps": c.prefill_only_steps,
+                "prefill_chunks": int(self._m_prefill_chunks.value),
+                "prefill_queue_depth": c.prefill_queue_depth,
+                "evicted_midflight": c.evicted_midflight,
+                "step_tokens": {
+                    k: round(st[k], 3) if st[k] is not None else None
+                    for k in ("p50", "p95", "p99", "mean")
+                },
+            }
         tracing = {
             "enabled": bool(self.config.trace),
             **self.traces.stats(),
@@ -1940,6 +2045,7 @@ class ModelServer:
         return {
             "mesh": mesh,
             "kv": kv,
+            "chunked": chunked,
             "speculation": speculation,
             "quant": quant,
             **resilience,
@@ -2031,6 +2137,11 @@ class ModelServer:
                     # reflect the queue NOW, not the last admission event
                     if server._coalescer is not None:
                         server._m_queue_depth.set(server._coalescer.depth)
+                        pq = getattr(
+                            server._coalescer, "prefill_queue_depth", None
+                        )
+                        if pq is not None:
+                            server._m_prefill_queue.set(pq)
                     self._send_raw(
                         200,
                         server.telemetry.render_prometheus().encode(),
@@ -2205,3 +2316,421 @@ class ModelServer:
             self._httpd.server_close()
             self._httpd = None
         self._draining = False  # a restarted server admits again
+
+
+def _pow2_at_least(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class _StepEngine:
+    """serving.steps.StepEngine over ModelServer's jitted programs.
+
+    Per-row device state (suffix array, write frontier, sampling cursor,
+    stream buffer, drafter) lives on `req.step` — the RowStep the
+    scheduler reads plus engine-private fields — so a watchdog restart
+    carries nothing over. Everything here is byte-identity-preserving
+    against the classic one-shot group path (pinned by
+    tests/test_serving_chunked.py): chunk slices feed the SAME
+    left-padded suffix layout, the final slice samples fold_in(key, 0),
+    and decode steps sample fold_in(key, g) exactly like the scan body
+    of `jit_paged_chunk`."""
+
+    def __init__(self, server: ModelServer):
+        self._s = server
+
+    # --------------------------------------------------------------- protocol
+    def supports(self, r: PendingRequest) -> bool:
+        return (
+            self._s._kv is not None
+            and r.kv_plan is not None
+            and r.key.num_beams == 1
+        )
+
+    def begin(self, r: PendingRequest) -> None:
+        import time as _time
+
+        import numpy as np
+
+        from .steps import RowStep
+
+        s = self._s
+        kv = s._kv
+        key = r.key
+        st = RowStep(
+            phase="prefill",
+            cost=(key.draft_tokens + 1) if key.speculate else 1,
+        )
+        L, pb, nb = key.prefix_len, key.prompt_bucket, key.new_bucket
+        sfx = r.tokens[L:]
+        arr = np.zeros((1, pb), np.int32)
+        if sfx:
+            arr[0, pb - len(sfx):] = sfx
+        st.arr = arr
+        st.pad = pb - len(sfx)
+        st.L, st.pb, st.nb = L, pb, nb
+        # tables are padded with the scratch page up to a power-of-2 width
+        # so rows with different page counts share compiled step shapes;
+        # reads beyond a row's own span are masked dead (exact 0.0 after
+        # softmax), so the wider window is byte-identical
+        st.n_pages = kv.layout.pages_for(L + pb + nb - 1)
+        st.wt = _pow2_at_least(st.n_pages)
+        st.chunk_w = min(max(1, int(s.config.prefill_chunk_tokens)), pb)
+        st.off = 0
+        st.next_chunk = min(st.chunk_w, pb)
+        st.gid = next(s._group_seq)
+        st.window = 0
+        st.gen = None
+        st.buf = []
+        qnow = _time.monotonic()  # same clock as PendingRequest.enqueued_at
+        s._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+        st.t_prev = _now()
+        if r.trace is not None:
+            r.trace.set_group(st.gid)
+            start = r.submitted_t if r.submitted_t is not None else r.trace.t0
+            r.trace.add(
+                "queue_wait",
+                start=start,
+                dur_s=st.t_prev - start,
+                group=st.gid,
+                row=r.row,
+            )
+        r.step = st
+
+    def prefill_chunk(self, r: PendingRequest) -> int:
+        import jax.numpy as jnp
+        import numpy as np
+
+        s = self._s
+        kv = s._kv
+        st = r.step
+        key = r.key
+        width = min(st.chunk_w, st.pb - st.off)
+        final = st.off + width >= st.pb
+        # chaos point: a fault here lands BETWEEN prefill chunks — the
+        # row fails with its page table half-built, and on_finish must
+        # return every page (tests/test_serving_chunked.py chaos case)
+        inject("serving.prefill_chunk", row=r.row, off=st.off)
+        kv.ensure_pages(
+            [r.kv_plan], upto_slot=st.L + st.off + width, traces=[r.trace]
+        )
+        table = kv.tables([r.kv_plan], 1, st.wt)
+        chunk = st.arr[:, st.off : st.off + width]
+        pads = np.asarray([st.pad], np.int32)
+        pls = np.asarray([st.L], np.int32)
+        seeds = np.asarray([r.seed], np.int32)
+        with s._lock:
+            fn = s._prefill_chunk_fn(final, key.temperature, key.top_k)
+            out = fn(
+                s.params,
+                kv.cache,
+                jnp.asarray(chunk),
+                jnp.asarray(pads),
+                jnp.asarray(pls),
+                jnp.asarray(table),
+                jnp.asarray(seeds),
+                jnp.asarray(st.L + st.off, jnp.int32),
+            )
+            if final:
+                kv.cache, first = out
+            else:
+                kv.cache = out
+        st.off += width
+        s._m_prefill_chunks.inc()
+        tnow = _now()
+        if r.trace is not None:
+            r.trace.add(
+                "prefill",
+                start=st.t_prev,
+                dur_s=tnow - st.t_prev,
+                group=st.gid,
+                row=r.row,
+                chunk_off=st.off - width,
+                chunk_tokens=width,
+                prefix_len=st.L,
+                suffix_bucket=st.pb,
+            )
+        st.t_prev = tnow
+        if not final:
+            st.next_chunk = min(st.chunk_w, st.pb - st.off)
+            return width
+        # prefill boundary: the first sampled token leaves NOW — TTFT no
+        # longer waits for co-resident prompts (the whole point)
+        first_i = int(np.asarray(first)[0])
+        r.first_token_at = tnow
+        if r.t0 is not None:
+            s._m_ttft.observe((tnow - r.t0) * 1e3)
+        st.gen = [first_i]
+        st.decode_t0 = tnow
+        self._emit(r, [first_i])
+        if key.eos_id is not None and first_i == key.eos_id:
+            # everything after a generated eos is pinned: finish host-side
+            fill = [int(key.eos_id)] * (r.max_new - 1)
+            st.gen.extend(fill)
+            self._emit(r, fill)
+            self._finish_row(r)
+        elif r.max_new <= 1:
+            self._finish_row(r)
+        else:
+            st.tok = first_i
+            st.done = False
+            st.pos = st.L + st.pb
+            st.g = 1
+            if key.speculate:
+                from ..models.spec_decode import NgramDrafter
+
+                st.drafter = NgramDrafter(r.tokens + [first_i])
+                st.remaining = r.max_new - 1
+            st.phase = "decode"
+        return width
+
+    def lanes(self, rows: list) -> list[list]:
+        """Plain rows share one compiled step program per sampling
+        signature (pos/g/prefix_lens are traced); speculative rows need
+        the verify window's static shape, so their lanes key on
+        (draft_tokens, prefix_len) too. Lanes split at max_batch."""
+        groups: dict = {}
+        for r in rows:
+            k = r.key
+            if k.speculate:
+                lane_key = (
+                    "spec", k.draft_tokens, k.prefix_len, k.temperature,
+                    k.top_k, k.eos_id,
+                )
+            else:
+                lane_key = ("plain", k.temperature, k.top_k, k.eos_id)
+            groups.setdefault(lane_key, []).append(r)
+        mb = max(1, int(self._s.config.max_batch))
+        out = []
+        for g in groups.values():
+            for i in range(0, len(g), mb):
+                out.append(g[i : i + mb])
+        return out
+
+    def decode(self, lane: list) -> int:
+        if lane[0].key.speculate:
+            return self._decode_spec(lane)
+        return self._decode_plain(lane)
+
+    # -------------------------------------------------------------- internals
+    def _emit(self, r: PendingRequest, toks: list) -> None:
+        # len(), not truthiness: spec windows pass numpy slices
+        if len(toks) and r.on_tokens is not None:
+            try:
+                r.on_tokens([int(t) for t in toks])
+            except Exception:  # noqa: BLE001 — a dead client stays local
+                pass
+
+    def _finish_row(self, r: PendingRequest) -> None:
+        s = self._s
+        kv = s._kv
+        st = r.step
+        st.phase = "done"
+        tnow = _now()
+        if (
+            r.trace is not None
+            and not r.key.speculate
+            and st.gen is not None
+            and len(st.gen) > 1
+        ):
+            r.trace.add(
+                "decode",
+                start=st.decode_t0,
+                dur_s=tnow - st.decode_t0,
+                group=st.gid,
+                row=r.row,
+                steps=len(st.gen) - 1,
+            )
+        th0 = _now()
+        try:
+            with s._lock:  # harvest donates the pool buffer too
+                kv.harvest([(r.tokens, r.kv_plan, int(st.pad), r.trace)])
+        except Exception:  # noqa: BLE001 — cache warmth must not fail rows
+            pass
+        th1 = _now()
+        if r.trace is not None:
+            r.trace.add(
+                "kv_harvest", start=th0, dur_s=th1 - th0, group=st.gid,
+                row=r.row,
+            )
+        r.finish(result=list(r.tokens) + st.gen[: r.max_new])
+        s._m_requests.inc(1)
+
+    def _decode_plain(self, lane: list) -> int:
+        import jax.numpy as jnp
+        import numpy as np
+
+        s = self._s
+        kv = s._kv
+        key0 = lane[0].key
+        n = len(lane)
+        inject("serving.slow", rows=n)
+        inject("serving.decode", rows=n)
+        bb = batch_bucket(n, max(n, s.config.max_batch))
+        wt = max(r.step.wt for r in lane)
+        tok = np.zeros((bb,), np.int32)
+        done = np.ones((bb,), bool)  # dummy rows: latched done
+        pads = np.zeros((bb,), np.int32)
+        pls = np.zeros((bb,), np.int32)
+        seeds = np.zeros((bb,), np.int32)
+        pos = np.zeros((bb,), np.int64)
+        g = np.ones((bb,), np.int64)
+        plans = [r.kv_plan for r in lane] + [None] * (bb - n)
+        for i, r in enumerate(lane):
+            st = r.step
+            tok[i] = st.tok
+            done[i] = st.done
+            pads[i] = st.pad
+            pls[i] = st.L
+            seeds[i] = r.seed
+            pos[i] = st.pos
+            g[i] = st.g
+        kv.ensure_pages(
+            plans[:n],
+            upto_slot=int(pos[:n].max()) + 1,
+            traces=[r.trace for r in lane],
+        )
+        tables = kv.tables(plans, bb, wt)
+        with s._lock:
+            fn = s._paged_step_fn(key0.temperature, key0.top_k, key0.eos_id)
+            kv.cache, nxt, done_out = fn(
+                s.params,
+                kv.cache,
+                jnp.asarray(tok),
+                jnp.asarray(done),
+                jnp.asarray(pads),
+                jnp.asarray(pls),
+                jnp.asarray(tables),
+                jnp.asarray(seeds),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(g, jnp.int32),
+            )
+        nxt = np.asarray(nxt)
+        done_out = np.asarray(done_out)
+        chunk_cap = max(1, int(s.config.stream_chunk_tokens))
+        for i, r in enumerate(lane):
+            st = r.step
+            t = int(nxt[i])
+            st.gen.append(t)
+            st.buf.append(t)
+            st.tok = t
+            st.done = bool(done_out[i])
+            st.pos += 1
+            st.g += 1
+            if key0.eos_id is not None and t == key0.eos_id:
+                fill = [int(key0.eos_id)] * (r.max_new - len(st.gen))
+                st.gen.extend(fill)
+                st.buf.extend(fill)
+                self._emit(r, st.buf)
+                st.buf = []
+                self._finish_row(r)
+            elif len(st.gen) >= r.max_new:
+                self._emit(r, st.buf)
+                st.buf = []
+                self._finish_row(r)
+            elif len(st.buf) >= chunk_cap:
+                # same emission cadence as the classic chunk loop: one
+                # event per stream_chunk_tokens decoded tokens
+                self._emit(r, st.buf)
+                st.buf = []
+        return n
+
+    def _decode_spec(self, lane: list) -> int:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.spec_decode import commit_window
+
+        s = self._s
+        kv = s._kv
+        key0 = lane[0].key
+        n = len(lane)
+        K = int(key0.draft_tokens)
+        L = int(key0.prefix_len)
+        inject("serving.slow", rows=n)
+        inject("serving.decode", rows=n)
+        bb = batch_bucket(n, max(n, s.config.max_batch))
+        wt = max(r.step.wt for r in lane)
+        fed = np.zeros((bb, K + 1), np.int32)
+        pads = np.zeros((bb,), np.int32)
+        seeds = np.zeros((bb,), np.int32)
+        pos = np.zeros((bb,), np.int64)
+        start_g = np.ones((bb,), np.int64)
+        done = np.zeros((bb,), bool)
+        remaining = np.zeros((bb,), np.int64)
+        plans = [r.kv_plan for r in lane] + [None] * (bb - n)
+        for i, r in enumerate(lane):
+            st = r.step
+            fed[i, 0] = st.tok
+            fed[i, 1:] = (
+                st.drafter.propose(K) if st.remaining > 0 else st.tok
+            )
+            pads[i] = st.pad
+            seeds[i] = r.seed
+            pos[i] = st.pos
+            start_g[i] = st.g
+            done[i] = st.done
+            remaining[i] = st.remaining
+        frontier = int(pos[:n].max()) + K + 1
+        kv.ensure_pages(
+            plans[:n], upto_slot=frontier, traces=[r.trace for r in lane]
+        )
+        tables = kv.tables(plans, bb, wt)
+        with s._lock:
+            fn = s._spec_verify_paged_fn(
+                bb, K, L, wt, key0.temperature, key0.top_k, key0.eos_id
+            )
+            kv.cache, targets, accept = fn(
+                s.params,
+                kv.cache,
+                jnp.asarray(fed),
+                jnp.asarray(done),
+                jnp.asarray(pads),
+                jnp.asarray(tables),
+                jnp.asarray(seeds),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(start_g, jnp.int32),
+            )
+        committed, done2, remaining2, eos_hit, delta = commit_window(
+            fed, targets, accept, remaining, done, key0.eos_id
+        )
+        s._spec_observe(delta)
+        tnow = _now()
+        for i, r in enumerate(lane):
+            st = r.step
+            if r.trace is not None:
+                r.trace.add(
+                    "verify",
+                    start=st.t_prev,
+                    dur_s=tnow - st.t_prev,
+                    group=st.gid,
+                    row=r.row,
+                    window=st.window,
+                    proposed=delta["proposed"],
+                    accepted=delta["accepted"],
+                    rollback=delta["rollback"],
+                )
+            st.t_prev = tnow
+            st.window += 1
+            toks = committed[i]
+            if len(toks):
+                # classic spec cadence: each window's committed tokens
+                # are one streamed event
+                st.gen.extend(int(t) for t in toks)
+                self._emit(r, toks)
+                st.drafter.extend(toks)
+                st.tok = int(toks[-1])
+                st.pos += len(toks)
+                st.g += len(toks)
+            st.done = bool(done2[i])
+            st.remaining = int(remaining2[i])
+            if eos_hit[i] and st.remaining > 0:
+                fill = [int(key0.eos_id)] * st.remaining
+                st.gen.extend(fill)
+                self._emit(r, fill)
+                st.remaining = 0
+            if st.remaining <= 0:
+                self._finish_row(r)
+        return n * (K + 1)

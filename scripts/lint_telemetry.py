@@ -101,6 +101,22 @@ texts; timelines must replay byte-identical from the same log). Any
 direct `time.time/monotonic/perf_counter/sleep` (and `_ns` variants) or
 `datetime.now/utcnow/today` call in those files is forbidden.
 
+Eleventh rule: NO raw clock in the step scheduler. The chunked-prefill
+step loop (`polyaxon_tpu/serving/steps.py`) decides what each device
+step runs purely from logical state — token budgets, chunk offsets,
+row phases — and delegates every time-touching concern outward: row
+deadlines are evaluated by `PendingRequest.expired()` (the monotonic
+clock lives in batching.py, rule 3), and every duration the operator
+sees (TTFT, step tokens, queue wait) is observed by the server's
+engine on the telemetry clock. A raw `time.*()` / `datetime.now()`
+read inside the scheduler would couple step composition to host timing
+— the same request mix could schedule differently across runs, and
+the byte-identity story (chunked ≡ one-shot) would no longer be
+testable by replay. Any direct `time.time/monotonic/perf_counter/
+sleep` (and `_ns` variants) or `datetime.now/utcnow/today` call in
+that file is forbidden: schedule on logical state, take time through
+injected collaborators.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -168,6 +184,15 @@ PURE_MODULES = (
     ("polyaxon_tpu", "telemetry", "federate.py"),
     ("polyaxon_tpu", "store", "timeline.py"),
 )
+STEPS_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: the chunked-prefill step scheduler schedules on logical state only
+#: (rule 11); clocks live in its collaborators
+STEPS_MODULES = (
+    ("polyaxon_tpu", "serving", "steps.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -211,6 +236,7 @@ def violations(repo_root: Path) -> list[str]:
         in_router = rel.parts in ROUTER_MODULES
         in_store = rel.parts in STORE_MODULES
         in_pure = rel.parts in PURE_MODULES
+        in_steps = rel.parts in STEPS_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -262,6 +288,13 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: clock in a pure transform — "
                     f"federation/timeline code has no time "
                     f"axis: {line.strip()}"
+                )
+            if in_steps and STEPS_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in the step scheduler — "
+                    f"schedule on logical state; deadlines and "
+                    f"durations belong to its collaborators: "
+                    f"{line.strip()}"
                 )
     return out
 

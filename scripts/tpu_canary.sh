@@ -356,6 +356,125 @@ PY
       echo "SPEC-QUANT-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # chunked-prefill gate: fire one long-prompt/long-decode request and,
+    # while it is in flight, a short streamed request against a
+    # chunkedPrefill server. The short request's first token must land
+    # BEFORE the long request finishes (the step scheduler's whole point
+    # — no head-of-line blocking), and the three new series must be on
+    # /metricsz. A chunked deployment whose step telemetry is dark
+    # cannot be tuned, so either failure FAILS the canary.
+    echo "running chunked-prefill smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+server = ModelServer(
+    b.module, params,
+    config=ServingConfig(max_batch=4, max_wait_ms=5.0,
+                         kv_pool_pages=64, kv_page_tokens=8,
+                         stream_chunk_tokens=2, chunked_prefill=True,
+                         prefill_chunk_tokens=16, max_step_tokens=64),
+)
+port = server.start(port=0)
+base = f"http://127.0.0.1:{port}"
+
+
+def post(body, path="/generate"):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=300)
+
+
+long_body = {"tokens": [list(range(1, 97))], "maxNewTokens": 24,
+             "temperature": 0.5, "topK": 10, "seed": 0}
+short_body = {"tokens": [list(range(1, 9))], "maxNewTokens": 4,
+              "temperature": 0.5, "topK": 10, "seed": 1}
+try:
+    # warm both shapes so compiles don't land in the timed race
+    post(long_body).read()
+    post(short_body).read()
+
+    long_done_at = [None]
+
+    def fire_long():
+        post(long_body).read()
+        long_done_at[0] = time.perf_counter()
+
+    t = threading.Thread(target=fire_long, daemon=True)
+    t.start()
+    time.sleep(0.02)  # let the long prefill enter the step loop
+    resp = post(short_body, "/generate?stream=1")
+    short_first_at = None
+    buf = b""
+    while True:
+        chunk = resp.read(64)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            ev = json.loads(frame[len(b"data: "):])
+            if "tokens" in ev and short_first_at is None:
+                short_first_at = time.perf_counter()
+    t.join(timeout=300)
+    text = urllib.request.urlopen(f"{base}/metricsz", timeout=30
+                                  ).read().decode()
+    stats = json.loads(urllib.request.urlopen(f"{base}/statsz", timeout=30
+                                              ).read())
+finally:
+    server.stop()
+with open("tpu_results/chunked_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "serving_prefill_chunks_total",
+    "serving_step_tokens",
+    "serving_prefill_queue_depth",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("chunked-prefill smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+ch = stats["chunked"]
+if not ch.get("enabled") or ch.get("prefill_chunks", 0) < 2:
+    print("chunked-prefill smoke: step scheduler did not chunk", ch)
+    sys.exit(1)
+if short_first_at is None or long_done_at[0] is None:
+    print("chunked-prefill smoke: race did not complete")
+    sys.exit(1)
+if short_first_at >= long_done_at[0]:
+    print("chunked-prefill smoke: short TTFT waited out the long request "
+          f"(short first token {short_first_at:.3f} vs long done "
+          f"{long_done_at[0]:.3f}) — head-of-line blocking is back")
+    sys.exit(1)
+print(f"chunked-prefill smoke: ok ({len(required)} required series "
+      f"present, {ch['prefill_chunks']} chunks over {ch['steps']} steps, "
+      f"short first token {long_done_at[0] - short_first_at:.3f}s before "
+      "long finish)")
+PY
+    then
+      echo "CHUNKED-PREFILL-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     # elastic gate: a seeded preempt-shrink-resume through the REAL stack
     # (two-tier checkpoints, eviction at peak, halving-ladder re-admission
     # on a half-stolen fleet), then require the elastic series on

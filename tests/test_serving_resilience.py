@@ -199,6 +199,36 @@ def test_coalescer_drops_expired_before_dispatch():
     assert c.deadline_dropped == 1
 
 
+def test_coalescer_evicts_expired_nonhead_while_coalescing():
+    # ISSUE 14 regression: the coalesce wait used to be computed from the
+    # HEAD row only, so a short-deadline row queued behind a deadline-less
+    # head sat out the head's whole max_wait before its 504. The wait must
+    # be capped at the earliest pending deadline: the non-head row fails
+    # fast, spends no step tokens, and the head is NOT dispatched early.
+    batches = []
+
+    def execute(batch):
+        batches.append([r.seed for r in batch])
+        for r in batch:
+            r.finish(result=list(r.tokens))
+
+    c = DecodeCoalescer(execute, max_batch=4, max_wait_ms=1500.0)
+    c.start()
+    r1 = _req(seed=1)  # head: no deadline, coalescing for up to 1.5s
+    c.submit(r1)
+    time.sleep(0.02)
+    r2 = _req(seed=2, deadline_ms=40.0)  # non-head, expires mid-coalesce
+    c.submit(r2)
+    assert r2.done.wait(0.75), "non-head row waited out the head's max_wait"
+    assert isinstance(r2.error, DeadlineExceededError)
+    assert c.deadline_dropped == 1
+    # eviction must not have flushed the head before ITS max_wait
+    assert not r1.done.is_set() and batches == []
+    c.stop(drain_s=5.0)  # drain wakes the coalesce wait and flushes the head
+    assert r1.done.is_set() and r1.result is not None
+    assert batches == [[1]]  # r2 never reached the executor
+
+
 def test_coalescer_breaker_opens_then_recovers():
     fail = {"n": 3}
 
