@@ -2016,6 +2016,7 @@ class ModelServer:
                 "max_step_tokens": int(self.config.max_step_tokens),
                 "steps": c.steps_run,
                 "prefill_only_steps": c.prefill_only_steps,
+                "classic_forced_steps": c.classic_forced_steps,
                 "prefill_chunks": int(self._m_prefill_chunks.value),
                 "prefill_queue_depth": c.prefill_queue_depth,
                 "evicted_midflight": c.evicted_midflight,

@@ -19,7 +19,9 @@ head-of-line blocker). `StepScheduler` replaces the group loop with a
   slot" goodput contract;
 - rows the engine cannot step (beam search) fall back to the classic
   blocking group execute, scheduled as an exclusive step so they keep
-  working without starving the step loop.
+  working without starving the step loop — and forced to run after
+  `CLASSIC_STARVE_STEPS` consecutive steppable steps so sustained
+  steppable load cannot starve THEM either.
 
 The scheduler subclasses `DecodeCoalescer` so admission (`submit`,
 shed/breaker/queue bounds), drain/stop, and the crash watchdog are
@@ -96,6 +98,13 @@ class StepScheduler(DecodeCoalescer):
     stop, watchdog) from `DecodeCoalescer` unchanged; `_loop` is the
     step loop described in the module docstring."""
 
+    #: consecutive steppable steps a non-empty classic (beam) pool may
+    #: wait before an exclusive classic step is forced. Mirrors the
+    #: `_starved` prefill flag: under sustained decode load the classic
+    #: pool would otherwise never see the "both pools empty" condition
+    #: and starve until deadline eviction (or forever, with no deadline).
+    CLASSIC_STARVE_STEPS = 8
+
     def __init__(
         self,
         execute: Callable[[list[PendingRequest]], None],
@@ -133,9 +142,11 @@ class StepScheduler(DecodeCoalescer):
         self._decoding: list[PendingRequest] = []
         self._classic: deque[PendingRequest] = deque()
         self._starved = False  # budget excluded prefill last step
+        self._classic_waits = 0  # steppable steps run while classic waited
         # step telemetry (read by /statsz and the interference bench)
         self.steps_run = 0
         self.prefill_only_steps = 0
+        self.classic_forced_steps = 0
         self.evicted_midflight = 0
 
     # ---------------------------------------------------------- introspection
@@ -160,11 +171,18 @@ class StepScheduler(DecodeCoalescer):
         self._prefilling.clear()
         self._decoding.clear()
         self._classic.clear()
+        # only rows not already terminal count: after a crash the
+        # watchdog failed AND resolved the in-flight rows still sitting
+        # in the pools (the done-row sweep runs after the stop check),
+        # so resolving them again would undercount _outstanding and let
+        # drain() report idle with admitted requests still unresolved
+        n = 0
         for r in active:
             if not r.done.is_set():
                 r.finish(error=error)
-        if active:
-            self._resolve(len(active))
+                n += 1
+        if n:
+            self._resolve(n)
 
     def _evict_expired_active(self) -> None:
         """PR 5 semantics mid-flight: a row whose deadline passed is
@@ -271,10 +289,21 @@ class StepScheduler(DecodeCoalescer):
             self._admit_active()
             if not (self._prefilling or self._decoding or self._classic):
                 continue
-            # 4. classic fallback groups run as exclusive steps
-            if self._classic and not (self._prefilling or self._decoding):
-                self._run_classic_step()
-                continue
+            # 4. classic fallback groups run as exclusive steps:
+            # immediately when nothing is steppable, and FORCED after
+            # CLASSIC_STARVE_STEPS consecutive steppable steps so beam
+            # rows cannot starve under sustained steppable load
+            if self._classic:
+                forced = self._classic_waits >= self.CLASSIC_STARVE_STEPS
+                if forced or not (self._prefilling or self._decoding):
+                    if forced and (self._prefilling or self._decoding):
+                        self.classic_forced_steps += 1
+                    self._classic_waits = 0
+                    self._run_classic_step()
+                    continue
+                self._classic_waits += 1
+            else:
+                self._classic_waits = 0
             # 5. compose the step: all decode lanes + at most one prefill
             # slice, within max_step_tokens
             decode_rows = list(self._decoding)
@@ -329,8 +358,15 @@ class StepScheduler(DecodeCoalescer):
                 else:
                     if pf.step.phase != "prefill":
                         self._prefilling.remove(pf)
-                        if pf.step.phase == "decode":
+                        if pf.step.phase == "decode" and not pf.done.is_set():
                             self._decoding.append(pf)
+                        else:
+                            # the row finished during its final slice
+                            # (EOS as first token, maxNewTokens <= 1):
+                            # the step-7 reap scans only _decoding, so
+                            # it must resolve here or _outstanding
+                            # leaks +1 until submit sheds everything
+                            self._resolve()
                     elif len(self._prefilling) > 1:
                         # round-robin: later arrivals get the next slices
                         self._prefilling.rotate(-1)

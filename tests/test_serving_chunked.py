@@ -62,12 +62,13 @@ class FakeEngine(StepEngine):
     submit another request mid-flight deterministically."""
 
     def __init__(self, chunks=1, decode_steps=2, chunk_tokens=4,
-                 supported=None, decode_sleep=0.0):
+                 supported=None, decode_sleep=0.0, finish_on_prefill=None):
         self.chunks = chunks
         self.decode_steps = decode_steps
         self.chunk_tokens = chunk_tokens
         self.supported = supported or (lambda r: True)
         self.decode_sleep = decode_sleep
+        self.finish_on_prefill = finish_on_prefill or (lambda r: False)
         self.gates: dict[int, threading.Event] = {}
         self.log: list[tuple] = []
 
@@ -90,7 +91,14 @@ class FakeEngine(StepEngine):
         self.log.append(("prefill", req.seed))
         req.chunks_left -= 1
         if req.chunks_left <= 0:
-            req.step.phase = "decode"
+            if self.finish_on_prefill(req):
+                # the real engine's EOS-as-first-token / maxNewTokens<=1
+                # path: the row finishes straight out of its final slice,
+                # phase prefill -> done without ever decoding
+                req.step.phase = "done"
+                req.finish(result=list(req.tokens))
+            else:
+                req.step.phase = "decode"
         return req.step.next_chunk
 
     def lanes(self, rows):
@@ -220,6 +228,83 @@ def test_unsupported_rows_fall_back_to_classic_blocking_steps():
         s.stop()
     assert sorted(x for b in batches for x in b) == [1, 2]
     assert not eng.log  # the engine never saw the beam rows
+
+
+def test_row_finishing_in_final_prefill_slice_resolves_depth():
+    # REVIEW high: when the engine finishes a row straight out of its
+    # final prefill slice (EOS as first token, maxNewTokens <= 1) the
+    # scheduler must still resolve it — a leak here accumulates
+    # _outstanding (+1 per such row) until depth hits max_queue and
+    # EVERY subsequent submit sheds queue_full, forever
+    eng = FakeEngine(finish_on_prefill=lambda r: True)
+    s = StepScheduler(lambda b: None, eng, max_wait_ms=0, max_queue=4)
+    s.start()
+    try:
+        rows = [_req(seed=i) for i in range(8)]  # 2x max_queue
+        for r in rows:
+            s.submit(r)
+            assert r.done.wait(5) and r.result is not None
+        deadline = time.monotonic() + 5.0
+        while s.depth and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert s.depth == 0 and s.idle
+        assert all(e[0] == "prefill" for e in eng.log)  # never decoded
+    finally:
+        s.stop()
+
+
+def test_classic_rows_do_not_starve_under_sustained_step_load():
+    # REVIEW medium: a beam (classic) row used to run only when BOTH
+    # step pools were empty, so sustained steppable load starved it
+    # indefinitely. It must now get a forced exclusive step after at
+    # most CLASSIC_STARVE_STEPS steppable steps.
+    executed = []
+
+    def execute(batch):
+        executed.append([r.seed for r in batch])
+        for r in batch:
+            r.finish(result=list(r.tokens))
+
+    eng = FakeEngine(
+        chunks=1, decode_steps=100_000, supported=lambda r: r.seed != 9
+    )
+    s = StepScheduler(execute, eng, max_wait_ms=0)
+    s.start()
+    try:
+        stepper = _req(seed=1)
+        s.submit(stepper)
+        for _ in range(200):  # the stepper holds the loop busy?
+            if s._decoding or s._prefilling:
+                break
+            time.sleep(0.005)
+        classic = _req(seed=9)
+        s.submit(classic)
+        assert classic.done.wait(5) and classic.result is not None
+        assert not stepper.done.is_set()  # the steppable row kept going
+        assert s.classic_forced_steps >= 1
+    finally:
+        s.stop()
+    assert executed == [[9]]
+
+
+def test_fail_active_skips_already_resolved_rows():
+    # REVIEW low: after a worker crash the watchdog fails AND resolves
+    # the in-flight rows, but they are still sitting in the pools when a
+    # stop arrives (the done-row sweep runs after the stop check).
+    # _fail_active must not resolve them again, or _outstanding
+    # undercounts and drain() reports idle with requests unresolved.
+    from polyaxon_tpu.serving.batching import ServerClosingError
+
+    eng = FakeEngine()
+    s = StepScheduler(lambda b: None, eng, max_wait_ms=0)
+    crashed = _req(seed=1)
+    crashed.finish(error=RuntimeError("watchdog already failed this row"))
+    live = _req(seed=2)
+    s._decoding.extend([crashed, live])
+    s._outstanding = 2  # the live row + one request still parked upstream
+    s._fail_active(ServerClosingError("going down"))
+    assert live.done.is_set()
+    assert s.depth == 1  # exactly the live row resolved, not len(active)
 
 
 # -------------------------------------------------- end-to-end identity
