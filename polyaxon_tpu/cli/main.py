@@ -796,6 +796,21 @@ def agent_drain(queues):
               help="int8 weight-only quantize the projection kernels at "
                    "load (per-output-channel scales; prefill/embed/lm_head "
                    "stay full precision)")
+@click.option("--draft-model", default=None, type=str,
+              help="swap the n-gram proposer for a real small draft model: "
+                   "k=v,k=v config overrides (e.g. n_layers=2), or 'auto' "
+                   "for the default half-depth truncation (requires "
+                   "--speculate)")
+@click.option("--adaptive-draft", is_flag=True,
+              help="steer the speculative draft width K from the live "
+                   "accept rate: ramp on copy-friendly traffic, shrink on "
+                   "high-entropy, auto-disable + reprobe when speculation "
+                   "loses (requires --speculate)")
+@click.option("--kv-quant", default=None,
+              type=click.Choice(["none", "int8"]),
+              help="store the paged KV pool int8-per-slot with f32 scales "
+                   "(~2x resident rows per HBM byte; requires "
+                   "--kv-pool-pages)")
 @click.option("--chunked-prefill", is_flag=True,
               help="slice prompt prefill into bounded chunks interleaved "
                    "with decode steps so short requests are not stuck "
@@ -829,7 +844,8 @@ def agent_drain(queues):
 def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           max_queue, default_deadline_ms, drain_grace_s, breaker_threshold,
           expected_devices, kv_pool_pages, kv_page_tokens, no_prefix_cache,
-          no_stream, speculate, draft_tokens, quantize, chunked_prefill,
+          no_stream, speculate, draft_tokens, quantize, draft_model,
+          adaptive_draft, kv_quant, chunked_prefill,
           no_chunked_prefill, prefill_chunk_tokens, max_step_tokens,
           no_trace, replicas, mesh_model, route, autoscale_max):
     """Serve a checkpointed LM run's generation over HTTP
@@ -872,6 +888,30 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         overrides["speculate"] = True
     if quantize:
         overrides["quantize"] = True
+    if draft_model is not None:
+        from ..serving.batching import normalize_draft_model
+
+        if draft_model.strip().lower() == "auto":
+            spec = {}
+        else:
+            try:
+                spec = {}
+                for part in draft_model.split(","):
+                    k, v = part.split("=", 1)
+                    try:
+                        spec[k.strip()] = int(v)
+                    except ValueError:
+                        spec[k.strip()] = float(v)
+            except ValueError:
+                raise click.ClickException(
+                    f"--draft-model expects 'auto' or k=v[,k=v...] numeric "
+                    f"overrides, got {draft_model!r}"
+                )
+        overrides["draft_model"] = normalize_draft_model(spec)
+    if adaptive_draft:
+        overrides["adaptive_draft"] = True
+    if kv_quant is not None:
+        overrides["kv_quant"] = kv_quant
     if chunked_prefill and no_chunked_prefill:
         raise click.ClickException(
             "--chunked-prefill and --no-chunked-prefill are exclusive"
@@ -958,6 +998,7 @@ _SERVE_FLAG_SPELLING = {
     "kv_pool_pages": "--kv-pool-pages",
     "kv_page_tokens": "--kv-page-tokens",
     "draft_tokens": "--draft-tokens",
+    "kv_quant": "--kv-quant",
     "prefill_chunk_tokens": "--prefill-chunk-tokens",
     "max_step_tokens": "--max-step-tokens",
 }
@@ -986,6 +1027,11 @@ def _serve_child_argv(uid, port, mesh_axes, overrides, expected_devices):
             argv += ["--no-trace"]
         elif field in ("speculate", "quantize") and value:
             argv += [f"--{field}"]
+        elif field == "adaptive_draft" and value:
+            argv += ["--adaptive-draft"]
+        elif field == "draft_model" and value is not None:
+            argv += ["--draft-model",
+                     ",".join(f"{k}={v}" for k, v in value) or "auto"]
         elif field == "chunked_prefill":
             argv += ["--chunked-prefill" if value else "--no-chunked-prefill"]
         elif field in _SERVE_FLAG_SPELLING:

@@ -72,16 +72,28 @@ def page_hashes(
 @dataclasses.dataclass(frozen=True)
 class PagedKVLayout:
     """Static shape of the device pool — hashable so it can ride jit keys
-    and flax module attributes."""
+    and flax module attributes.
+
+    `kv_quant` selects the pool element type (ISSUE 15): "int8" stores
+    each K/V slot as int8 with one f32 scale per (slot, kv head) —
+    quantized per slot, so pool bytes are a pure function of token
+    content and the prefix-cache content hashes stay valid — fitting
+    roughly `head_dim * fp_bytes / (head_dim + 4)` times more pages into
+    the same HBM; "none" keeps the activation dtype."""
 
     page_tokens: int = DEFAULT_PAGE_TOKENS
     pool_pages: int = 0
+    kv_quant: str = "none"  # none | int8
 
     def __post_init__(self):
         if self.page_tokens < 1:
             raise ValueError(f"page_tokens must be >= 1, got {self.page_tokens}")
         if self.pool_pages < 1:
             raise ValueError(f"pool_pages must be >= 1, got {self.pool_pages}")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8', got {self.kv_quant!r}"
+            )
 
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold `n_tokens` cache slots."""

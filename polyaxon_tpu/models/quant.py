@@ -14,8 +14,21 @@ PER-OUTPUT-CHANNEL symmetric scale:
 int8×bf16/f32 matmul, no dequantized copy of the kernel ever
 materializes in HBM — and folds the scale into the f32 accumulator
 output. Embedding, lm_head and the norms stay full precision (the
-quality-critical ends of the network), as do LoRA adapters (quantizing a
-frozen base under trainable deltas is a training concern, rejected).
+quality-critical ends of the network). LoRA checkpoints quantize the
+FROZEN base kernel only: the adapter deltas (`lora_a`/`lora_b`) are a
+rank-r sliver of HBM and carry all the tenant-specific signal, so they
+stay at checkpoint precision while the shared base rides the int8 path
+(transformer.LoRADense with quant="int8" — ISSUE 15 lifted the old
+reject-LoRA restriction, unblocking multi-tenant int8 serving).
+
+The same per-channel scale machinery also backs the int8 KV-cache path
+(ISSUE 15): `quantize_kv` maps each cache slot's per-head K/V vector to
+an int8 payload plus one f32 scale per (slot, head). Quantization is a
+PURE function of the slot's own fp vector — no page- or chunk-level
+statistics — so the quantized bytes are identical no matter what order
+slots are written in (one-shot prefill, chunked prefill, COW reuse),
+which is what keeps the paged byte-identity contracts testable on a
+quantized pool.
 
 Quantize-on-load: serving restores the checkpoint's fp params with the
 ordinary module, calls `quantize_module()` once, and drops the dense
@@ -89,14 +102,55 @@ def quantize_kernel(w) -> tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale
 
 
+def quantize_kv(x) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., head_dim] fp K/V activations → (int8 payload, f32
+    scale[...]) with one symmetric scale per leading index (per cache
+    slot, per kv head). Same scheme as quantize_kernel, amax'd over the
+    head dim — a pure per-vector transform, so the quantized bytes never
+    depend on which prefill chunk or COW path wrote the slot."""
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x32 / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of quantize_kv: int8 payload [..., head_dim] + f32
+    scale [...] → fp values in `dtype`."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def kv_pool_bytes(layout, n_layers: int, n_kv_heads: int, head_dim: int,
+                  kv_dtype_bytes: int = 2) -> int:
+    """HBM bytes the paged K+V pool occupies under `layout`. int8 pools
+    pay 1 byte per element plus one f32 scale per (slot, head); fp pools
+    pay `kv_dtype_bytes` per element. The admission/bench accounting
+    (`kv_pool_bytes` on /statsz and the decode_bench int8-KV record)
+    reads this, so the ≥1.9× rows-per-HBM-byte claim is measured against
+    the same formula the server budgets with."""
+    slots = layout.pool_pages * layout.page_tokens
+    if getattr(layout, "kv_quant", "none") == "int8":
+        per_slot = n_kv_heads * (head_dim * 1 + 4)  # payload + f32 scale
+    else:
+        per_slot = n_kv_heads * head_dim * kv_dtype_bytes
+    return 2 * n_layers * slots * per_slot  # 2 = K and V
+
+
 def _is_mapping(x: Any) -> bool:
     return hasattr(x, "items") and not hasattr(x, "shape")
 
 
-def quantize_params(params) -> tuple[dict, int]:
+def quantize_params(params, *, allow_lora: bool = False) -> tuple[dict, int]:
     """Quantize every QUANT_TARGETS projection kernel in a params tree.
     Returns (new tree, HBM bytes saved). Non-target leaves pass through
-    untouched; a target that carries LoRA adapters is rejected."""
+    untouched. With `allow_lora`, a target that carries LoRA adapters
+    quantizes its frozen base `kernel` and passes `lora_a`/`lora_b`
+    through at checkpoint precision; without it such a target is
+    rejected (callers that cannot rebuild the module with the combined
+    int8+LoRA projection must not silently drop the adapters)."""
     saved = 0
 
     def walk(tree):
@@ -108,11 +162,13 @@ def quantize_params(params) -> tuple[dict, int]:
                 and _is_mapping(v)
                 and "kernel" in v
             ):
-                if any(name.startswith("lora_") for name in v):
+                has_lora = any(name.startswith("lora_") for name in v)
+                if has_lora and not allow_lora:
                     raise ValueError(
                         f"cannot int8-quantize {k!r}: it carries LoRA "
-                        "adapter params (serve the merged checkpoint "
-                        "instead)"
+                        "adapter params (pass allow_lora=True to "
+                        "quantize the frozen base and keep the adapter "
+                        "deltas fp)"
                     )
                 w = jnp.asarray(v["kernel"])
                 q, s = quantize_kernel(w)
@@ -122,6 +178,9 @@ def quantize_params(params) -> tuple[dict, int]:
                     - s.size * s.dtype.itemsize
                 )
                 out[k] = {"kernel": q, "scale": s}
+                for name, leaf in v.items():
+                    if name.startswith("lora_"):
+                        out[k][name] = leaf
             elif _is_mapping(v):
                 out[k] = walk(v)
             else:
@@ -165,11 +224,10 @@ def quantize_module(module, params) -> tuple[Any, dict, int]:
             f"module is already quantized (cfg.quant = {cfg.quant!r}) — "
             "quantize-on-load runs once, on the fp checkpoint"
         )
-    if getattr(cfg, "lora_rank", 0) > 0:
-        raise ValueError(
-            "int8 serving does not support LoRA checkpoints — merge the "
-            "adapters into the base kernels first"
-        )
-    qparams, saved = quantize_params(params)
+    # LoRA checkpoints: quantize the frozen base kernels, keep the
+    # adapter deltas fp — the rebuilt module's LoRADense picks the int8
+    # base path from cfg.quant and still applies the fp delta on top
+    lora = getattr(cfg, "lora_rank", 0) > 0
+    qparams, saved = quantize_params(params, allow_lora=lora)
     qmodule = type(module)(dataclasses.replace(cfg, quant="int8"))
     return qmodule, qparams, saved

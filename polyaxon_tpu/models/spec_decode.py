@@ -7,7 +7,10 @@ tokens at once:
   1. DRAFT (host, free): a per-row n-gram index over the row's own
      prompt + committed output proposes K likely continuations — no
      second model to place, and repetitive spans (code, templates,
-     shared-prefix boilerplate) hit long runs.
+     shared-prefix boilerplate) hit long runs. A real draft MODEL
+     (models/draft.ModelDrafter) can stand in for the n-gram index via
+     `spec_generate(drafter=...)` — stronger proposals on novel text,
+     same verify/commit machinery, still byte-identical.
   2. VERIFY (device, one forward): the previously sampled token plus the
      K drafts run through the decode path as ONE [B, K+1] window. Slot
      semantics are unchanged — position i writes cache slot pos + i and
@@ -264,10 +267,19 @@ def commit_window(fed, targets, accept, remaining, done, eos_id):
     remaining [B] (tokens the row may still emit; <= 0 = inactive row),
     done [B] (baseline eos latch entering the window). Returns
     (committed per-row list, done', remaining', eos_hit [B],
-    stats {proposed, accepted, rollback}).
+    stats {proposed, accepted, accepted_judged, truncated, rollback}).
 
     Active rows commit ncommit = min(accept + 1, remaining) tokens —
     always >= 1, so the loop makes progress even at zero acceptance.
+    `accepted` counts COMMITTED drafts (ncommit - 1): near
+    maxNewTokens the `remaining` clamp can truncate a long accepted run,
+    deflating accepted/proposed below the drafter's true quality.
+    `accepted_judged` counts every draft the verify forward actually
+    matched, truncated or not — the adaptive-K controller consumes this
+    corrected figure (a K decision is about the NEXT window, where no
+    budget clamp applies), while `truncated` (= judged - committed)
+    exposes the gap on /statsz. The two rates diverge only when a row's
+    accept run crosses its remaining budget.
     done' replays generate()'s latch (a row latches when a GENERATED eos
     token is FED, i.e. appears among fed[:ncommit]); eos_hit flags rows
     whose committed tokens contain eos — everything after is pinned to
@@ -282,7 +294,7 @@ def commit_window(fed, targets, accept, remaining, done, eos_id):
     remaining = np.array(remaining, np.int64)
     eos_hit = np.zeros(B, bool)
     committed: list[np.ndarray] = []
-    proposed = accepted = rollback = 0
+    proposed = accepted = judged = truncated = rollback = 0
     for b in range(B):
         if remaining[b] <= 0:
             committed.append(np.empty((0,), np.int32))
@@ -292,6 +304,9 @@ def commit_window(fed, targets, accept, remaining, done, eos_id):
         toks = targets[b, :n].astype(np.int32)
         committed.append(toks)
         accepted += n - 1
+        j = int(min(int(accept[b]), K))
+        judged += j
+        truncated += j - (n - 1)
         rollback += K - (n - 1)
         if eos_id is not None:
             if (fed[b, :n] == eos_id).any():
@@ -299,7 +314,13 @@ def commit_window(fed, targets, accept, remaining, done, eos_id):
             if (toks == eos_id).any():
                 eos_hit[b] = True
         remaining[b] -= n
-    stats = {"proposed": proposed, "accepted": accepted, "rollback": rollback}
+    stats = {
+        "proposed": proposed,
+        "accepted": accepted,
+        "accepted_judged": judged,
+        "truncated": truncated,
+        "rollback": rollback,
+    }
     return committed, done, remaining, eos_hit, stats
 
 
@@ -319,10 +340,23 @@ def spec_generate(
     prefill_fn=None,  # prebuilt jit_spec_prefill (callers reusing compiles)
     verify_fn=None,  # prebuilt jit_spec_verify
     stats: Optional[dict] = None,  # accumulates proposed/accepted/rollback
+    drafter=None,  # models.draft.ModelDrafter — replaces the n-gram index
+    controller=None,  # adaptive-K hook: window_k()/observe()/tick_plain()
 ) -> jnp.ndarray:
     """Speculative drop-in for generate() on the dense cache: same
     [B, P + max_new_tokens] result, byte-identical per row, usually far
-    fewer forward passes. See the module docstring for the contract."""
+    fewer forward passes. See the module docstring for the contract.
+
+    With `controller` (serving.adaptive.AdaptiveSpecController or any
+    duck-type) each window asks `window_k()` for its draft width:
+    `draft_tokens` becomes the cap, a smaller k shrinks the window, and
+    k == 0 degenerates to a width-1 window — EXACTLY one plain decode
+    step through the same verify program family, which is the auto-
+    disable fallback. After each window the controller is fed the
+    truncation-corrected accept counts (`observe`) or, for plain
+    windows, a logical re-probe tick (`tick_plain`). jit retraces per
+    window width, so an adapting K grows the compile ladder one entry
+    per distinct width — bounded by draft_tokens."""
     cfg = module.cfg
     B, P = prompt.shape
     K = int(draft_tokens)
@@ -372,12 +406,14 @@ def spec_generate(
     buf[:, :P] = prompt_np
     buf[:, P] = first
 
-    drafters = [
-        NgramDrafter(prompt_np[b, P - lengths[b] :], ngram_max=ngram_max)
-        for b in range(B)
-    ]
-    for b in range(B):
-        drafters[b].extend([first[b]])
+    drafters: list[NgramDrafter] = []
+    if drafter is None:
+        drafters = [
+            NgramDrafter(prompt_np[b, P - lengths[b] :], ngram_max=ngram_max)
+            for b in range(B)
+        ]
+        for b in range(B):
+            drafters[b].extend([first[b]])
 
     tok = first.copy()  # last committed (not yet fed) token per row
     pos = np.full(B, P, np.int64)  # cache slot `tok` will occupy
@@ -390,12 +426,22 @@ def spec_generate(
         remaining[hit] = 0
 
     while (remaining > 0).any():
-        fed = np.empty((B, K + 1), np.int32)
+        k_eff = K if controller is None else min(K, int(controller.window_k()))
+        fed = np.empty((B, k_eff + 1), np.int32)
         fed[:, 0] = tok
-        for b in range(B):
-            fed[b, 1:] = (
-                drafters[b].propose(K) if remaining[b] > 0 else tok[b]
-            )
+        if k_eff:
+            if drafter is not None:
+                fed[:, 1:] = drafter.propose(tok, start_g, k_eff)
+                for b in range(B):
+                    if remaining[b] <= 0:
+                        fed[b, 1:] = tok[b]
+            else:
+                for b in range(B):
+                    fed[b, 1:] = (
+                        drafters[b].propose(k_eff)
+                        if remaining[b] > 0
+                        else tok[b]
+                    )
         cache, targets, accept = verify_fn(
             params, cache, jnp.asarray(fed), jnp.asarray(done), pad,
             seeds, jnp.asarray(pos, jnp.int32),
@@ -404,6 +450,11 @@ def spec_generate(
         committed, done, remaining, eos_hit, delta = commit_window(
             fed, targets, accept, remaining, done, eos_id
         )
+        if controller is not None:
+            if k_eff:
+                controller.observe(delta["proposed"], delta["accepted_judged"])
+            else:
+                controller.tick_plain(1)
         if stats is not None:
             for k, v in delta.items():
                 stats[k] = stats.get(k, 0) + v
@@ -414,7 +465,8 @@ def spec_generate(
                 continue
             at = P + start_g[b]
             buf[b, at : at + len(toks)] = toks
-            drafters[b].extend(toks)
+            if drafter is None:
+                drafters[b].extend(toks)
             tok[b] = toks[-1]
             pos[b] += len(toks)
             start_g[b] += len(toks)

@@ -66,6 +66,13 @@ class TransformerConfig:
     # pipeline parallelism: stage count (mesh `pipeline` axis size must match)
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # small-draft sub-config (ISSUE 15): field overrides applied to THIS
+    # config to shape the speculative draft model (models/draft.py) —
+    # fewer layers/dims, same architecture and tokenizer. Normalized to
+    # a sorted (key, value) tuple by _make_config (the `draft:` section
+    # of the model config) so the frozen config stays hashable; () means
+    # "use the draft defaults" (n_layers // 2).
+    draft: tuple = ()
     # fuse the lm head into the loss (ops/losses.fused_linear_masked_lm):
     # the [B,S,V] logits never materialize — the big activation-memory win
     # at llama vocab sizes on DP/FSDP meshes. Leave off under tensor
@@ -129,28 +136,53 @@ class RMSNorm(nn.Module):
 class LoRADense(nn.Module):
     """Dense whose base kernel is frozen (optimizer-masked) with a trainable
     low-rank delta: y = x W + (alpha/r)(x A)B. Param names carry `lora_` so
-    the bundle's trainable_patterns select them."""
+    the bundle's trainable_patterns select them.
+
+    With quant="int8" (serving quantize-on-load, ISSUE 15) the frozen
+    base kernel rides the same dequant-free mixed matmul as Int8Dense —
+    int8 kernel + per-output-channel f32 scale — while the adapter
+    deltas stay at checkpoint precision: the base carries the bulk of
+    the HBM traffic, the rank-r adapters carry the tenant signal."""
 
     features: int
     rank: int
     alpha: float
+    quant: str = "none"
 
     @nn.compact
     def __call__(self, x):
         in_dim = x.shape[-1]
-        kernel = self.param(
-            "kernel", nn.initializers.lecun_normal(), (in_dim, self.features)
-        )
+        if self.quant == "int8":
+            kernel = self.param(
+                "kernel", lambda _, s: jnp.zeros(s, jnp.int8),
+                (in_dim, self.features),
+            )
+            scale = self.param(
+                "scale", nn.initializers.ones, (self.features,)
+            )
+            y = jax.lax.dot_general(
+                x,
+                kernel,
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            y = (y * scale).astype(x.dtype)
+        else:
+            kernel = self.param(
+                "kernel", nn.initializers.lecun_normal(),
+                (in_dim, self.features),
+            )
+            y = x @ kernel.astype(x.dtype)
         a = self.param("lora_a", nn.initializers.normal(1e-2), (in_dim, self.rank))
         b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features))
-        y = x @ kernel.astype(x.dtype)
         delta = (x @ a.astype(x.dtype)) @ b.astype(x.dtype)
         return y + (self.alpha / self.rank) * delta
 
 
 def _proj(cfg: TransformerConfig, features: int, name: str):
     if cfg.lora_rank > 0 and (not cfg.lora_targets or name in cfg.lora_targets):
-        return LoRADense(features, rank=cfg.lora_rank, alpha=cfg.lora_alpha, name=name)
+        return LoRADense(features, rank=cfg.lora_rank, alpha=cfg.lora_alpha,
+                         quant=cfg.quant, name=name)
     if cfg.quant == "int8":
         from .quant import Int8Dense
 
@@ -217,16 +249,38 @@ class Attention(nn.Module):
             #    score -1e30, whose exp underflows to exact 0.0).
             is_step = self.has_variable("cache", "cached_key")
             paged = pages is not None
+            kv_int8 = paged and getattr(kv_layout, "kv_quant", "none") == "int8"
             if paged:
                 pt_sz, pool_sz = kv_layout.page_tokens, kv_layout.pool_pages
+                # int8 pool (ISSUE 15): the POOL holds int8 payloads plus
+                # one f32 scale per (slot, kv head); the fp K/V window
+                # only ever exists activation-sized after the gather, so
+                # HBM residency is ~hd/(hd*bytes+4) of the fp pool.
+                # Quantization is per-slot (quant.quantize_kv — a pure
+                # function of that token's own K/V vector), so the pool
+                # bytes are write-order independent: chunked prefill,
+                # one-shot prefill and COW prefix reuse produce the SAME
+                # quantized payload, keeping content-hash prefix reuse
+                # and the chunked≡one-shot byte-identity contract valid
+                # on a quantized pool.
+                pool_dt = jnp.int8 if kv_int8 else k.dtype
                 cached_k = self.variable(
                     "cache", "cached_key",
-                    lambda: jnp.zeros((pool_sz, pt_sz, nkv, hd), k.dtype),
+                    lambda: jnp.zeros((pool_sz, pt_sz, nkv, hd), pool_dt),
                 )
                 cached_v = self.variable(
                     "cache", "cached_value",
-                    lambda: jnp.zeros((pool_sz, pt_sz, nkv, hd), v.dtype),
+                    lambda: jnp.zeros((pool_sz, pt_sz, nkv, hd), pool_dt),
                 )
+                if kv_int8:
+                    cached_ks = self.variable(
+                        "cache", "cached_key_scale",
+                        lambda: jnp.zeros((pool_sz, pt_sz, nkv), jnp.float32),
+                    )
+                    cached_vs = self.variable(
+                        "cache", "cached_value_scale",
+                        lambda: jnp.zeros((pool_sz, pt_sz, nkv), jnp.float32),
+                    )
             else:
                 cached_k = self.variable(
                     "cache", "cached_key",
@@ -298,15 +352,55 @@ class Attention(nn.Module):
                         mode="fill", fill_value=pool_sz,
                     )
                     off = slots % pt_sz
-                    k_all = cached_k.value.at[pp, off].set(k, mode="drop")
-                    v_all = cached_v.value.at[pp, off].set(v, mode="drop")
-                    cached_k.value, cached_v.value = k_all, v_all
                     win = pages.shape[1] * pt_sz
-                    # gather the row's whole window back out of the pool;
-                    # unallocated tail entries alias a scratch page whose
-                    # garbage is masked dead below (slot > pos + i)
-                    k_all = k_all[pages].reshape(B, win, nkv, hd)
-                    v_all = v_all[pages].reshape(B, win, nkv, hd)
+                    if kv_int8:
+                        # quantize-on-write: per-slot per-head int8 +
+                        # f32 scale. The fresh K/V are read back DEQUANT
+                        # through the same gather as the history, so one
+                        # value of a slot exists — whichever path wrote
+                        # it, attention sees identical bytes.
+                        from .quant import dequantize_kv, quantize_kv
+
+                        kq, ks = quantize_kv(k)
+                        vq, vs = quantize_kv(v)
+                        k_all = cached_k.value.at[pp, off].set(
+                            kq, mode="drop"
+                        )
+                        v_all = cached_v.value.at[pp, off].set(
+                            vq, mode="drop"
+                        )
+                        ks_all = cached_ks.value.at[pp, off].set(
+                            ks, mode="drop"
+                        )
+                        vs_all = cached_vs.value.at[pp, off].set(
+                            vs, mode="drop"
+                        )
+                        cached_k.value, cached_v.value = k_all, v_all
+                        cached_ks.value, cached_vs.value = ks_all, vs_all
+                        k_all = dequantize_kv(
+                            k_all[pages].reshape(B, win, nkv, hd),
+                            ks_all[pages].reshape(B, win, nkv),
+                            k.dtype,
+                        )
+                        v_all = dequantize_kv(
+                            v_all[pages].reshape(B, win, nkv, hd),
+                            vs_all[pages].reshape(B, win, nkv),
+                            v.dtype,
+                        )
+                    else:
+                        k_all = cached_k.value.at[pp, off].set(
+                            k, mode="drop"
+                        )
+                        v_all = cached_v.value.at[pp, off].set(
+                            v, mode="drop"
+                        )
+                        cached_k.value, cached_v.value = k_all, v_all
+                        # gather the row's whole window back out of the
+                        # pool; unallocated tail entries alias a scratch
+                        # page whose garbage is masked dead below
+                        # (slot > pos + i)
+                        k_all = k_all[pages].reshape(B, win, nkv, hd)
+                        v_all = v_all[pages].reshape(B, win, nkv, hd)
                 elif per_row:
                     # rows at different frontiers: dynamic_update_slice's
                     # shared offset no longer applies, scatter per row
@@ -743,6 +837,17 @@ def _make_config(config: dict) -> TransformerConfig:
         config.setdefault("lora_alpha", float(lora.get("alpha", 16.0)))
         if lora.get("targets"):
             config.setdefault("lora_targets", tuple(lora["targets"]))
+    draft = config.pop("draft", None)
+    if draft:
+        # `draft:` sub-config (ISSUE 15): a dict of TransformerConfig
+        # overrides for the small draft model, normalized to a hashable
+        # sorted tuple (the frozen config must ride jit keys)
+        if hasattr(draft, "items"):
+            draft = tuple(sorted(
+                (str(k), tuple(v) if isinstance(v, list) else v)
+                for k, v in draft.items()
+            ))
+        config["draft"] = tuple(draft)
     preset = config.pop("preset", None)
     if preset is not None and preset not in PRESETS:
         raise ValueError(f"unknown preset {preset!r}; known: {sorted(PRESETS)}")

@@ -163,6 +163,19 @@ class V1ServingSpec(BaseSchema):
     speculate: bool = False
     draft_tokens: int | str = 4
     quantize: bool = False
+    # adaptive speculation + KV quantization (ISSUE 15): draftModel swaps
+    # the n-gram proposer for a real small draft model (same arch/vocab,
+    # overrides like {"n_layers": 2} layer over the config's `draft:`
+    # sub-config; params derive by layer truncation when widths match),
+    # adaptiveDraft turns on the accept-rate AIMD controller that steers
+    # the per-window K and auto-disables speculation when it loses, and
+    # kvQuant stores the paged KV pool int8-per-slot (~2x the resident
+    # rows per HBM byte; quantization is per-slot so chunked prefill,
+    # prefix hits and one-shot prefill stay byte-identical to each other
+    # on the quantized pool)
+    draft_model: Optional[dict[str, int | str | float | bool]] = None
+    adaptive_draft: bool = False
+    kv_quant: Literal["none", "int8"] = "none"
     # chunked prefill + step scheduling (ISSUE 14): chunkedPrefill slices
     # prefill into prefillChunkTokens-wide device steps interleaved with
     # decode (kills head-of-line blocking behind long prompts; requires
@@ -258,6 +271,21 @@ class V1ServingSpec(BaseSchema):
                 "kvPoolPages (page tables are what let a half-prefilled "
                 "row persist across device steps)"
             )
+        if self.kv_quant != "none" and self.kv_pool_pages is None:
+            raise ValueError(
+                "kvQuant requires the paged KV pool — set kvPoolPages "
+                "(dense per-group caches stay full-precision)"
+            )
+        if self.draft_model is not None and not self.speculate:
+            raise ValueError(
+                "draftModel requires speculate: true (the draft model is "
+                "a proposer for the speculative verify window)"
+            )
+        if self.adaptive_draft and not self.speculate:
+            raise ValueError(
+                "adaptiveDraft requires speculate: true (the controller "
+                "steers the speculative draft width K)"
+            )
         if isinstance(self.breaker_threshold, int) and self.breaker_threshold < 1:
             raise ValueError(
                 f"breakerThreshold must be >= 1, got {self.breaker_threshold}"
@@ -284,7 +312,11 @@ class V1ServingSpec(BaseSchema):
         return self
 
     def to_config(self):
-        from ..serving.batching import ServingConfig, normalize_mesh_axes
+        from ..serving.batching import (
+            ServingConfig,
+            normalize_draft_model,
+            normalize_mesh_axes,
+        )
 
         return ServingConfig(
             max_batch=int(self.max_batch),
@@ -317,6 +349,9 @@ class V1ServingSpec(BaseSchema):
             speculate=self.speculate,
             draft_tokens=int(self.draft_tokens),
             quantize=self.quantize,
+            draft_model=normalize_draft_model(self.draft_model),
+            adaptive_draft=self.adaptive_draft,
+            kv_quant=str(self.kv_quant),
             chunked_prefill=self.chunked_prefill,
             prefill_chunk_tokens=int(self.prefill_chunk_tokens),
             max_step_tokens=int(self.max_step_tokens),

@@ -1,0 +1,181 @@
+"""Accept-rate-driven speculation control (ISSUE 15).
+
+Speculation is a bet: a K-draft verify window costs one (K+1)-wide
+forward and pays off only when drafts are accepted. PR 8 made K a static
+config knob, which loses twice — on copy-friendly traffic a bigger K
+would commit longer runs, and on high-entropy traffic even K=1 turns
+every decode step into pure verify overhead (accept → 0). This module
+closes the loop: `AdaptiveSpecController` watches the same accept
+counters `/metricsz` already exports and steers the per-window draft
+width K, all the way down to disabling speculation entirely and back.
+
+The controller is deliberately tiny and AIMD-shaped:
+
+* Every `observe(proposed, accepted)` feeds one verify window's counts
+  into the current evaluation window (proposed tokens, not wall time).
+  Once `window` proposals accumulate, the corrected accept rate decides:
+  rate >= `raise_at` → K += 1 (cap `k_max`); rate < `lower_at` → K
+  halves (floor `k_min`); rate < `disable_at` while already at `k_min` →
+  speculation turns OFF.
+* Disabled means callers run PLAIN decode (`window_k() == 0`). Each
+  plain step reports `tick_plain(n)`; after `reprobe` logical steps the
+  controller re-enables at `k_min` and the next evaluation window
+  decides again — traffic that turns copy-friendly wins speculation
+  back, traffic that stays hot re-disables after one cheap probe window.
+* The CORRECTED accept rate (commit_window's `accepted_judged`) drives
+  decisions. The raw committed rate deflates near maxNewTokens (an
+  accepted run truncated by the remaining budget reads as rejection),
+  which would bias K downward exactly on the long-output requests where
+  speculation pays most. Both rates are exposed on /statsz.
+
+Everything here counts LOGICAL units — proposed tokens and decode
+steps — never wall clocks: a controller that keyed on time would couple
+K decisions to host scheduling jitter and break replayability
+(scripts/lint_telemetry.py rule 12 pins this module clock-free alongside
+models/draft.py).
+
+Thread-safety: serving calls `window_k()` from coalescer/step paths and
+`observe`/`tick_plain` from the decode worker; one lock covers the
+handful of integers.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AdaptiveSpecController:
+    """AIMD controller for the speculative draft width K.
+
+    `window_k()` is the current decision: 0 = speculation disabled (run
+    plain decode), k >= 1 = propose k drafts per verify window. Callers
+    feed back `observe(proposed, accepted)` per verify window and
+    `tick_plain(steps)` per plain decode step while disabled.
+    """
+
+    def __init__(
+        self,
+        *,
+        k_init: int = 4,
+        k_min: int = 1,
+        k_max: int = 8,
+        window: int = 64,
+        raise_at: float = 0.6,
+        lower_at: float = 0.2,
+        disable_at: float = 0.1,
+        reprobe: int = 256,
+    ):
+        if not (1 <= k_min <= k_init <= k_max):
+            raise ValueError(
+                f"need 1 <= k_min <= k_init <= k_max, got "
+                f"{k_min}/{k_init}/{k_max}"
+            )
+        if not (0.0 <= disable_at <= lower_at <= raise_at <= 1.0):
+            raise ValueError(
+                f"need 0 <= disable_at <= lower_at <= raise_at <= 1, got "
+                f"{disable_at}/{lower_at}/{raise_at}"
+            )
+        self.k_min = int(k_min)
+        self.k_max = int(k_max)
+        self.window = max(1, int(window))
+        self.raise_at = float(raise_at)
+        self.lower_at = float(lower_at)
+        self.disable_at = float(disable_at)
+        self.reprobe = max(1, int(reprobe))
+        self._lock = threading.Lock()
+        self._k = int(k_init)
+        self._disabled = False
+        # current evaluation window
+        self._proposed = 0
+        self._accepted = 0
+        # lifetime accounting (corrected, i.e. accepted_judged)
+        self.total_proposed = 0
+        self.total_accepted = 0
+        # raw committed counts ride along for the /statsz raw rate
+        self.total_accepted_raw = 0
+        self._plain_ticks = 0
+        self.adjustments = 0  # K changes (either direction)
+        self.disables = 0
+        self.reprobes = 0
+
+    # ------------------------------------------------------------- decisions
+    def window_k(self) -> int:
+        """Draft width for the next verify window; 0 = run plain decode."""
+        with self._lock:
+            return 0 if self._disabled else self._k
+
+    @property
+    def effective_k(self) -> int:
+        return self.window_k()
+
+    @property
+    def auto_disabled(self) -> bool:
+        with self._lock:
+            return self._disabled
+
+    # -------------------------------------------------------------- feedback
+    def observe(self, proposed: int, accepted: int,
+                accepted_raw: int | None = None) -> None:
+        """Feed one verify window's counts: `proposed` drafts offered,
+        `accepted` the truncation-CORRECTED accepts (accepted_judged).
+        `accepted_raw` (committed accepts) only feeds the /statsz raw
+        rate and defaults to `accepted`."""
+        with self._lock:
+            self.total_proposed += int(proposed)
+            self.total_accepted += int(accepted)
+            self.total_accepted_raw += int(
+                accepted if accepted_raw is None else accepted_raw
+            )
+            if self._disabled:
+                return  # stale feedback from in-flight spec groups
+            self._proposed += int(proposed)
+            self._accepted += int(accepted)
+            if self._proposed < self.window:
+                return
+            rate = self._accepted / self._proposed
+            self._proposed = 0
+            self._accepted = 0
+            if rate >= self.raise_at and self._k < self.k_max:
+                self._k += 1
+                self.adjustments += 1
+            elif rate < self.disable_at and self._k <= self.k_min:
+                self._disabled = True
+                self._plain_ticks = 0
+                self.disables += 1
+            elif rate < self.lower_at and self._k > self.k_min:
+                self._k = max(self.k_min, self._k // 2)
+                self.adjustments += 1
+
+    def tick_plain(self, steps: int = 1) -> None:
+        """Count logical plain decode steps while disabled; after
+        `reprobe` of them speculation re-enables at k_min for one fresh
+        evaluation window."""
+        with self._lock:
+            if not self._disabled:
+                return
+            self._plain_ticks += int(steps)
+            if self._plain_ticks >= self.reprobe:
+                self._disabled = False
+                self._k = self.k_min
+                self._proposed = 0
+                self._accepted = 0
+                self._plain_ticks = 0
+                self.reprobes += 1
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            prop = self.total_proposed
+            return {
+                "effective_k": 0 if self._disabled else self._k,
+                "auto_disabled": self._disabled,
+                "accept_rate_raw": (
+                    self.total_accepted_raw / prop if prop else 0.0
+                ),
+                "accept_rate_corrected": (
+                    self.total_accepted / prop if prop else 0.0
+                ),
+                "adjustments": self.adjustments,
+                "disables": self.disables,
+                "reprobes": self.reprobes,
+            }

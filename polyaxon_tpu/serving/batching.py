@@ -193,6 +193,20 @@ class ServingConfig:
     speculate: bool = False
     draft_tokens: int = 4
     quantize: bool = False
+    # adaptive speculation + KV quantization (ISSUE 15):
+    # draft_model — `draft:` sub-config overrides for a real draft model
+    #   (normalized sorted (key, value) tuple, hashable; None = n-gram
+    #   drafter). Weights derive by layer truncation of the served
+    #   checkpoint when the draft keeps the base widths.
+    # adaptive_draft — accept-rate-driven per-group K: ramp up on high
+    #   corrected accept rate, shrink toward 1, auto-disable (plain
+    #   decode) when speculation measurably loses, re-probe on a logical
+    #   cadence. Requires speculate.
+    # kv_quant — "int8" stores the paged pool as int8 payload + per-slot
+    #   f32 scales (~2-3.5x rows per HBM byte); requires kv_pool_pages.
+    draft_model: Optional[tuple[tuple[str, object], ...]] = None
+    adaptive_draft: bool = False
+    kv_quant: str = "none"
     # per-request tracing (ISSUE 9): build RequestTrace span trees and
     # retain them in the server's tail-sampling TraceRing (/tracez)
     trace: bool = True
@@ -237,6 +251,23 @@ def normalize_mesh_axes(spec) -> Optional[tuple[tuple[str, int], ...]]:
     if all(n == 1 for _, n in pairs):
         return None  # a 1x1 mesh IS the single-chip path; keep one identity
     return tuple(pairs)
+
+
+def normalize_draft_model(spec) -> Optional[tuple[tuple[str, object], ...]]:
+    """dict or pair-tuple of `draft:` overrides → the frozen hashable
+    `ServingConfig.draft_model` form (sorted (key, value) pairs; list
+    values become tuples). jax-free: schemas and the CLI call this before
+    any device exists; field validation happens when the model builds.
+
+    None means "no draft model"; an EMPTY dict/tuple means "auto" — build
+    the draft from the model config's own `draft:` sub-config defaults —
+    and normalizes to (), which is not-None so the server still builds."""
+    if spec is None:
+        return None
+    pairs = spec.items() if hasattr(spec, "items") else spec
+    return tuple(sorted(
+        (str(k), tuple(v) if isinstance(v, list) else v) for k, v in pairs
+    ))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -85,6 +85,7 @@ class KVCacheManager:
         prefix_cache: bool = True,
         hash_fn=None,
         observer: Optional[Callable[..., None]] = None,
+        kv_quant: str = "none",
     ):
         from ..models.generate import make_paged_cache
 
@@ -92,7 +93,15 @@ class KVCacheManager:
             raise ValueError(
                 f"kv_pool_pages must be >= 2 (1 scratch + data), got {pool_pages}"
             )
-        self.layout = PagedKVLayout(page_tokens=page_tokens, pool_pages=pool_pages)
+        # kv_quant = "int8" swaps the pool payload to int8 + per-slot f32
+        # scales (models/quant.quantize_kv) — same page accounting, ~2-3.5x
+        # the rows per HBM byte. Host-side admission/prefix logic is
+        # untouched: quantization is per-slot, so content hashes over the
+        # committed token stream stay valid and COW prefix pages carry
+        # write-order-independent bytes.
+        self.layout = PagedKVLayout(
+            page_tokens=page_tokens, pool_pages=pool_pages, kv_quant=kv_quant
+        )
         self.module = module
         self.pool = PagePool(pool_pages, page_tokens)
         self.prefix: Optional[PrefixCache] = (
@@ -311,11 +320,16 @@ class KVCacheManager:
             vals = vals.reshape(n_new, pt, *pool.shape[2:])
             return pool.at[new_ids].set(vals)
 
+        # scan_layers stacks a leading layer dim on every leaf; dispatch on
+        # the config, not leaf ndim — int8 pools carry 3-dim scale leaves
+        # whose scanned form is 4-dim, so an ndim test misclassifies them
+        scanned = bool(getattr(self.module.cfg, "scan_layers", False))
+
         def run(cache, table_row, start, new_ids):
             return jax.tree.map(
                 lambda p: (
                     jax.vmap(lambda lp: leaf4(lp, table_row, start, new_ids))(p)
-                    if p.ndim == 5  # scan_layers: leading layer dim
+                    if scanned
                     else leaf4(p, table_row, start, new_ids)
                 ),
                 cache,
@@ -381,10 +395,22 @@ class KVCacheManager:
         return inserted
 
     # ---------------------------------------------------------------- stats
+    def kv_pool_bytes(self) -> int:
+        """Actual HBM bytes of the device pool pytree (payload + scales) —
+        measured off the live leaves, so it is exact for any layout/quant
+        combination and matches models/quant.kv_pool_bytes by construction."""
+        import jax
+
+        return int(
+            sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(self.cache))
+        )
+
     def stats(self) -> dict:
         with self._lock:
             out = {
                 "page_tokens": self.layout.page_tokens,
+                "kv_quant": self.layout.kv_quant,
+                "kv_pool_bytes": self.kv_pool_bytes(),
                 "pages_total": self.pool.n_pages,
                 "pages_used": self.pool.used,
                 "pages_reserved": self.pool.reserved,

@@ -192,6 +192,23 @@ class ModelServer:
         mesh=None,
     ):
         self.config = config or ServingConfig()
+        # the run-spec path validates these combos in V1ServingSpec, but
+        # CLI overrides and direct construction land here unchecked — and
+        # a silently ignored kv_quant means an operator who asked for a
+        # halved pool is capacity-planning on memory they don't have
+        if (
+            self.config.kv_quant not in (None, "none")
+            and not self.config.kv_pool_pages
+        ):
+            raise ValueError(
+                "kv_quant requires the paged KV pool (set kv_pool_pages)"
+            )
+        if (
+            self.config.adaptive_draft or self.config.draft_model is not None
+        ) and not self.config.speculate:
+            raise ValueError(
+                "draft_model/adaptive_draft require speculate=True"
+            )
         # int8 quantize-on-load (ISSUE 8): rebuild the module with the
         # Int8Dense projection path and transform the restored fp params
         # BEFORE anything captures them — the dense projection kernels
@@ -228,6 +245,33 @@ class ModelServer:
             )
         self.module = module
         self.params = params
+        # adaptive speculation (ISSUE 15): an optional real draft model
+        # (weights derived by layer truncation of the SERVED tree — after
+        # quantize/mesh, so the draft rides the same int8/sharded params)
+        # and an accept-rate controller that steers the per-group draft
+        # width K, down to disabling speculation entirely
+        self._draft_module = None
+        self._draft_params = None
+        self._draft_derived = False
+        self._draft_propose_fns: dict = {}  # shared across groups/drafters
+        if self.config.draft_model is not None:
+            from ..models.draft import build_draft
+
+            (
+                self._draft_module,
+                self._draft_params,
+                self._draft_derived,
+            ) = build_draft(
+                module, params, overrides=dict(self.config.draft_model)
+            )
+        self._spec_controller = None
+        if self.config.adaptive_draft and self.config.speculate:
+            from .adaptive import AdaptiveSpecController
+
+            k0 = max(1, int(self.config.draft_tokens))
+            self._spec_controller = AdaptiveSpecController(
+                k_init=k0, k_min=1, k_max=max(k0, 8)
+            )
         self.model_name = model_name
         self.step = step
         # readiness: /readyz reports 503 while draining, and — when
@@ -348,6 +392,21 @@ class ModelServer:
             help="Draft tokens rejected and rolled back (their KV slots "
             "are masked dead and rewritten by the next window)",
         )
+        self._m_spec_truncated = self.telemetry.counter(
+            "serving.spec_truncated",
+            help="Accepted drafts the remaining-budget clamp kept out of "
+            "the commit (judged accepted, not committed) — the gap "
+            "between the raw and corrected accept rates",
+        )
+        self._m_spec_effective_k = self.telemetry.gauge(
+            "serving.spec_effective_k",
+            help="Current speculative draft width K (0 = speculation "
+            "auto-disabled or off; static draft_tokens without "
+            "adaptiveDraft)",
+        )
+        self._m_spec_effective_k.set(
+            int(self.config.draft_tokens) if self.config.speculate else 0
+        )
         self._m_quant_saved = self.telemetry.gauge(
             "serving.quant_bytes_saved",
             help="HBM bytes saved by int8 weight-only quantization "
@@ -459,6 +518,7 @@ class ModelServer:
                 page_tokens=int(self.config.kv_page_tokens),
                 prefix_cache=bool(self.config.prefix_cache),
                 observer=self._kv_observe,
+                kv_quant=str(self.config.kv_quant or "none"),
             )
             self._m_kv_total.set(self._kv.pool.n_pages)
             self._m_kv_used.set(self._kv.pool.used)
@@ -916,12 +976,21 @@ class ModelServer:
         cfg = self.module.cfg
         # decode mode (ISSUE 8): constant per server, but part of the
         # group signature so mixed-mode groups can never form (and the
-        # compiled-program keys below inherit it via the key fields)
+        # compiled-program keys below inherit it via the key fields).
+        # With the adaptive controller (ISSUE 15) the draft width — and
+        # whether the group speculates at all — is the controller's
+        # CURRENT decision: new groups land in plain lanes while
+        # speculation is auto-disabled, and re-enter spec lanes at the
+        # re-probed K. In-flight groups keep their admitted key.
+        spec_on = bool(self.config.speculate)
+        eff_k = int(self.config.draft_tokens) if spec_on else 0
+        if spec_on and self._spec_controller is not None:
+            eff_k = int(self._spec_controller.window_k())
+            spec_on = eff_k > 0
+            self._m_spec_effective_k.set(eff_k)
         mode = dict(
-            speculate=bool(self.config.speculate),
-            draft_tokens=(
-                int(self.config.draft_tokens) if self.config.speculate else 0
-            ),
+            speculate=spec_on,
+            draft_tokens=eff_k,
             quantize=bool(self.config.quantize),
         )
         out = []
@@ -1065,6 +1134,7 @@ class ModelServer:
                     steps=N,
                     row=r.row,
                 )
+        self._spec_tick_plain(N)
         self._m_requests.inc(n)
 
     # ------------------------------------------------- speculative decode
@@ -1116,9 +1186,58 @@ class ModelServer:
         )
 
     def _spec_observe(self, stats: dict) -> None:
-        self._m_spec_proposed.inc(int(stats.get("proposed", 0)))
-        self._m_spec_accepted.inc(int(stats.get("accepted", 0)))
+        proposed = int(stats.get("proposed", 0))
+        accepted = int(stats.get("accepted", 0))
+        self._m_spec_proposed.inc(proposed)
+        self._m_spec_accepted.inc(accepted)
         self._m_spec_rollback.inc(int(stats.get("rollback", 0)))
+        self._m_spec_truncated.inc(int(stats.get("truncated", 0)))
+        if self._spec_controller is not None and proposed:
+            # the controller eats the truncation-CORRECTED accepts — the
+            # raw committed count deflates near maxNewTokens and would
+            # bias K downward on exactly the long-output requests where
+            # speculation pays most (satellite of ISSUE 15)
+            self._spec_controller.observe(
+                proposed,
+                int(stats.get("accepted_judged", accepted)),
+                accepted_raw=accepted,
+            )
+            self._m_spec_effective_k.set(self._spec_controller.window_k())
+
+    def _spec_tick_plain(self, steps: int) -> None:
+        """Logical plain-decode progress: while the controller has
+        speculation auto-disabled, these ticks drive the clock-free
+        re-probe cadence."""
+        if self._spec_controller is not None and steps > 0:
+            self._spec_controller.tick_plain(int(steps))
+            self._m_spec_effective_k.set(self._spec_controller.window_k())
+
+    def _draft_prefill_fn(self):
+        from ..models.draft import jit_draft_prefill
+
+        return self._cached(
+            ("draft_prefill",),
+            lambda: jit_draft_prefill(self._draft_module),
+        )
+
+    def _make_drafter(self, prompts, lengths, seeds, *, temperature, top_k):
+        """Batched ModelDrafter over the group's bucketed prompts (call
+        under _lock — the ctor runs the draft prefill). Compiled draft
+        programs are shared across all groups via the server-wide
+        prefill fn and propose-fn dict."""
+        from ..models.draft import ModelDrafter
+
+        return ModelDrafter(
+            self._draft_module,
+            self._draft_params,
+            prompts,
+            lengths,
+            seeds=seeds,
+            temperature=temperature,
+            top_k=top_k,
+            prefill_fn=self._draft_prefill_fn(),
+            propose_fns=self._draft_propose_fns,
+        )
 
     def _execute_group_spec(self, batch: list[PendingRequest]):
         """Dense-cache speculative group: same bucketed shapes and
@@ -1159,6 +1278,12 @@ class ModelServer:
             verify_fn = self._spec_verify_fn(
                 bb, key.draft_tokens, key.temperature, key.top_k, key.eos_id
             )
+            drafter = None
+            if self._draft_module is not None:
+                drafter = self._make_drafter(
+                    arr, lengths, seeds,
+                    temperature=key.temperature, top_k=key.top_k,
+                )
             out = np.asarray(
                 spec_generate(
                     self.module,
@@ -1174,6 +1299,7 @@ class ModelServer:
                     prefill_fn=prefill_fn,
                     verify_fn=verify_fn,
                     stats=stats,
+                    drafter=drafter,
                 )
             )
         self._spec_observe(stats)
@@ -1282,11 +1408,29 @@ class ModelServer:
 
         # per-row loop state: drafters over the FULL prompt (prefix
         # included — that's where the repetitive material usually is),
-        # write frontier `pos`, generation index `start_g`
-        drafters = [
-            NgramDrafter(batch[i].tokens + [int(first_np[i])])
-            for i in range(n)
-        ]
+        # write frontier `pos`, generation index `start_g`. A configured
+        # draft MODEL replaces the n-gram index with one batched drafter
+        # whose own dense cache spans prefix + suffix bucket, so its
+        # frontier (base + start_g - 1) coincides with the paged pos.
+        drafter = None
+        drafters: list = []
+        if self._draft_module is not None:
+            dP = L + pb
+            dprompts = np.zeros((bb, dP), np.int32)
+            dlens = np.ones((bb,), np.int64)
+            for i, r in enumerate(batch):
+                dprompts[i, dP - len(r.tokens):] = r.tokens
+                dlens[i] = len(r.tokens)
+            with self._lock:
+                drafter = self._make_drafter(
+                    dprompts, dlens, seeds,
+                    temperature=key.temperature, top_k=key.top_k,
+                )
+        else:
+            drafters = [
+                NgramDrafter(batch[i].tokens + [int(first_np[i])])
+                for i in range(n)
+            ]
         tok = np.zeros((bb,), np.int32)
         tok[:n] = first_np[:n]
         pos = np.full((bb,), L + pb, np.int64)
@@ -1300,17 +1444,27 @@ class ModelServer:
                 # rest host-side and retire the row
                 emit(i, [int(key.eos_id)] * int(remaining[i]))
                 remaining[i] = 0
-        totals = {"proposed": 0, "accepted": 0, "rollback": 0}
+        totals = {
+            "proposed": 0, "accepted": 0, "accepted_judged": 0,
+            "truncated": 0, "rollback": 0,
+        }
         t_prev, window = _now(), 0
         while (remaining > 0).any():
             fed = np.empty((bb, K + 1), np.int32)
             fed[:, 0] = tok
-            for b in range(bb):
-                fed[b, 1:] = (
-                    drafters[b].propose(K)
-                    if b < n and remaining[b] > 0
-                    else tok[b]
-                )
+            if drafter is not None:
+                with self._lock:
+                    fed[:, 1:] = drafter.propose(tok, start_g, K)
+                for b in range(bb):
+                    if not (b < n and remaining[b] > 0):
+                        fed[b, 1:] = tok[b]
+            else:
+                for b in range(bb):
+                    fed[b, 1:] = (
+                        drafters[b].propose(K)
+                        if b < n and remaining[b] > 0
+                        else tok[b]
+                    )
             frontier = int(pos[:n].max()) + K + 1
             kv.ensure_pages(plans[:n], upto_slot=frontier)
             tables = kv.tables(plans, bb, n_pages)
@@ -1354,7 +1508,8 @@ class ModelServer:
                 if not len(toks):
                     continue
                 emit(i, toks)
-                drafters[i].extend(toks)
+                if drafter is None:
+                    drafters[i].extend(toks)
                 tok[i] = toks[-1]
                 pos[i] += len(toks)
                 start_g[i] += len(toks)
@@ -1581,6 +1736,7 @@ class ModelServer:
                         group=gid, row=r.row, window=window, steps=steps,
                     )
             t_prev, window = t_new, window + 1
+            self._spec_tick_plain(steps)
             pos += steps
             g += steps
             remaining -= steps
@@ -1992,16 +2148,45 @@ class ModelServer:
             }
         proposed = int(self._m_spec_proposed.value)
         accepted = int(self._m_spec_accepted.value)
+        truncated = int(self._m_spec_truncated.value)
         speculation = {
             "enabled": bool(self.config.speculate),
             "draft_tokens": int(self.config.draft_tokens),
             "proposed": proposed,
             "accepted": accepted,
+            "truncated": truncated,
             "rollbacks": int(self._m_spec_rollback.value),
+            # raw rate counts only COMMITTED accepts; the corrected rate
+            # re-credits accepted drafts truncated by maxNewTokens, which
+            # is what the adaptive controller steers on (PR 8 deflation
+            # fix — they diverge only near the end of a request's budget)
             "accept_rate": (
                 round(accepted / proposed, 4) if proposed else None
             ),
+            "accept_rate_raw": (
+                round(accepted / proposed, 4) if proposed else None
+            ),
+            "accept_rate_corrected": (
+                round((accepted + truncated) / proposed, 4)
+                if proposed else None
+            ),
+            "adaptive": bool(self._spec_controller is not None),
+            "effective_k": int(self._m_spec_effective_k.value),
+            "auto_disabled": bool(
+                self._spec_controller is not None
+                and self._spec_controller.auto_disabled
+            ),
+            "draft_model": (
+                None
+                if self._draft_module is None
+                else {
+                    "n_layers": int(self._draft_module.cfg.n_layers),
+                    "derived": bool(self._draft_derived),
+                }
+            ),
         }
+        if self._spec_controller is not None:
+            speculation["controller"] = self._spec_controller.stats()
         quant = {
             "enabled": bool(self.config.quantize),
             "bytes_saved": int(self._quant_bytes_saved),
@@ -2480,9 +2665,27 @@ class _StepEngine:
             st.pos = st.L + st.pb
             st.g = 1
             if key.speculate:
-                from ..models.spec_decode import NgramDrafter
+                # step lanes recompose every step, so a batched draft
+                # cache cannot follow a row between lanes: each row gets
+                # its own B=1 drafter (prompt padded to the bucketed
+                # width, so draft compiles stay ladder-bounded)
+                if s._draft_module is not None:
+                    import numpy as _np
 
-                st.drafter = NgramDrafter(r.tokens + [first_i])
+                    dP = st.L + st.pb
+                    dprompt = _np.zeros((1, dP), _np.int32)
+                    dprompt[0, dP - len(r.tokens):] = r.tokens
+                    with s._lock:
+                        st.drafter = s._make_drafter(
+                            dprompt, [len(r.tokens)], [r.seed],
+                            temperature=key.temperature, top_k=key.top_k,
+                        )
+                    st.model_draft = True
+                else:
+                    from ..models.spec_decode import NgramDrafter
+
+                    st.drafter = NgramDrafter(r.tokens + [first_i])
+                    st.model_draft = False
                 st.remaining = r.max_new - 1
             st.phase = "decode"
         return width
@@ -2636,6 +2839,7 @@ class _StepEngine:
                 # event per stream_chunk_tokens decoded tokens
                 self._emit(r, st.buf)
                 st.buf = []
+        s._spec_tick_plain(1)
         return n
 
     def _decode_spec(self, lane: list) -> int:
@@ -2665,9 +2869,13 @@ class _StepEngine:
         for i, r in enumerate(lane):
             st = r.step
             fed[i, 0] = st.tok
-            fed[i, 1:] = (
-                st.drafter.propose(K) if st.remaining > 0 else st.tok
-            )
+            if st.remaining <= 0:
+                fed[i, 1:] = st.tok
+            elif getattr(st, "model_draft", False):
+                with s._lock:
+                    fed[i, 1:] = st.drafter.propose([st.tok], [st.g], K)[0]
+            else:
+                fed[i, 1:] = st.drafter.propose(K)
             pads[i] = st.pad
             seeds[i] = r.seed
             pos[i] = st.pos
@@ -2721,7 +2929,10 @@ class _StepEngine:
                 # are one streamed event
                 st.gen.extend(int(t) for t in toks)
                 self._emit(r, toks)
-                st.drafter.extend(toks)
+                if not getattr(st, "model_draft", False):
+                    # the ModelDrafter's cache frontier is a function of
+                    # st.g alone; only the n-gram index needs the text
+                    st.drafter.extend(toks)
                 st.tok = int(toks[-1])
                 st.pos += len(toks)
                 st.g += len(toks)

@@ -117,6 +117,20 @@ sleep` (and `_ns` variants) or `datetime.now/utcnow/today` call in
 that file is forbidden: schedule on logical state, take time through
 injected collaborators.
 
+Twelfth rule: NO raw clock in adaptive speculation. The draft model
+(`polyaxon_tpu/models/draft.py`) keys its cache frontier and its sampling
+schedule purely on the logical generation index — the same
+`fold_in(key, g)` discipline rule 6 pins for spec_decode — and the
+accept-rate controller (`polyaxon_tpu/serving/adaptive.py`) windows its
+K decisions on PROPOSED-TOKEN counts and re-probes on logical plain-step
+ticks. A wall-clock read in either would couple the draft width (and so
+the entire serving batch composition) to host scheduling jitter: the
+same traffic would speculate differently across runs and the
+byte-identity replays the tests pin would stop being replays. Any
+`time.time/monotonic/perf_counter/sleep` (and `_ns` variants) or
+`datetime.now/utcnow/today` call in those two files is forbidden: count
+proposals and logical steps, never seconds.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -193,6 +207,16 @@ STEPS_PATTERN = re.compile(
 STEPS_MODULES = (
     ("polyaxon_tpu", "serving", "steps.py"),
 )
+ADAPTIVE_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: adaptive speculation counts proposals and logical steps, never
+#: seconds (rule 12): drafting and K control must replay deterministically
+ADAPTIVE_MODULES = (
+    ("polyaxon_tpu", "models", "draft.py"),
+    ("polyaxon_tpu", "serving", "adaptive.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -237,6 +261,7 @@ def violations(repo_root: Path) -> list[str]:
         in_store = rel.parts in STORE_MODULES
         in_pure = rel.parts in PURE_MODULES
         in_steps = rel.parts in STEPS_MODULES
+        in_adaptive = rel.parts in ADAPTIVE_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -295,6 +320,12 @@ def violations(repo_root: Path) -> list[str]:
                     f"schedule on logical state; deadlines and "
                     f"durations belong to its collaborators: "
                     f"{line.strip()}"
+                )
+            if in_adaptive and ADAPTIVE_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in adaptive speculation — "
+                    f"drafting and K control count proposals and "
+                    f"logical steps, never seconds: {line.strip()}"
                 )
     return out
 

@@ -356,6 +356,132 @@ PY
       echo "SPEC-QUANT-METRICSZ-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # adaptive-spec gate (ISSUE 15): warm HIGH-ENTROPY traffic through an
+    # adaptive speculative server must drive serving_spec_effective_k
+    # down from the configured K — a shrink or a full auto-disable — with
+    # ZERO failed requests (adaptation is a perf decision, never a
+    # correctness event), and an int8-KV server must serve byte-identical
+    # greedy output one-shot vs chunked on the quantized pool. A
+    # controller that lets losing speculation run unbounded, or a
+    # quantized pool that changes bytes with write order, FAILS.
+    echo "running adaptive-spec smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+
+
+def post(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return r.status, json.loads(r.read())
+
+
+K0 = 4
+server = ModelServer(
+    b.module, params,
+    config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                         kv_pool_pages=64, kv_page_tokens=8,
+                         speculate=True, draft_tokens=K0,
+                         adaptive_draft=True),
+)
+port = server.start(port=0)
+failed = 0
+try:
+    rng = np.random.RandomState(0)
+    for i in range(4):  # high-entropy: the n-gram drafter gets nothing
+        body = {
+            "tokens": [rng.randint(1, 256, size=12).tolist()
+                       for _ in range(4)],
+            "maxNewTokens": 24, "temperature": 0.0,
+        }
+        status, _ = post(port, body)
+        if status != 200:
+            failed += 1
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statsz", timeout=30
+    ).read())
+finally:
+    server.stop()
+with open("tpu_results/adaptive_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = ("serving_spec_effective_k", "serving_spec_truncated_total")
+missing = [s for s in required if s not in text]
+if missing:
+    print("adaptive-spec smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+if failed:
+    print(f"adaptive-spec smoke: {failed} failed requests during adaptation")
+    sys.exit(1)
+sp = stats["speculation"]
+eff, dis = sp["effective_k"], sp["auto_disabled"]
+if not (dis or eff < K0):
+    print("adaptive-spec smoke: high-entropy traffic left K unbounded",
+          {"effective_k": eff, "auto_disabled": dis})
+    sys.exit(1)
+
+# int8-KV byte identity: one-shot vs chunked prefill on the QUANTIZED
+# pool must agree bit for bit (quantize-on-write is per-slot, so bytes
+# never depend on which chunk wrote them)
+kv_kw = dict(max_batch=4, max_wait_ms=10.0, kv_pool_pages=64,
+             kv_page_tokens=8, kv_quant="int8")
+one = ModelServer(b.module, params, config=ServingConfig(**kv_kw))
+two = ModelServer(b.module, params, config=ServingConfig(
+    **kv_kw, chunked_prefill=True, prefill_chunk_tokens=16,
+    max_step_tokens=64))
+p1, p2 = one.start(port=0), two.start(port=0)
+try:
+    body = {"tokens": [list(range(1, 41)), list(range(7, 47))],
+            "maxNewTokens": 12, "temperature": 0.0}
+    s1, o1 = post(p1, body)
+    s2, o2 = post(p2, body)
+    kv_stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{p1}/statsz", timeout=30
+    ).read())["kv"]
+finally:
+    one.stop()
+    two.stop()
+if s1 != 200 or s2 != 200:
+    print("adaptive-spec smoke: int8-KV request failed", s1, s2)
+    sys.exit(1)
+if o1["tokens"] != o2["tokens"]:
+    print("adaptive-spec smoke: int8-KV greedy output diverged "
+          "one-shot vs chunked", o1["tokens"], o2["tokens"])
+    sys.exit(1)
+if kv_stats.get("kv_quant") != "int8" or kv_stats.get("kv_pool_bytes", 0) <= 0:
+    print("adaptive-spec smoke: quantized pool accounting dark", kv_stats)
+    sys.exit(1)
+print(f"adaptive-spec smoke: ok (effective_k {K0} -> {eff}, "
+      f"auto_disabled={dis}, zero failed requests, int8-KV byte-identical, "
+      f"kv_pool_bytes={kv_stats['kv_pool_bytes']})")
+PY
+    then
+      echo "ADAPTIVE-SPEC-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     # chunked-prefill gate: fire one long-prompt/long-decode request and,
     # while it is in flight, a short streamed request against a
     # chunkedPrefill server. The short request's first token must land

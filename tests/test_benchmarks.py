@@ -106,6 +106,63 @@ def test_decode_bench_schema(tmp_home):
     assert q["top1_agreement"] >= 0.75, q
     assert q["logit_max_abs_delta"] < 1.0, q
 
+    # ISSUE 15: the draft-model record — a real small model proposes,
+    # the target verifies, outputs stay byte-identical, and the draft
+    # weights were derived from the target (no separate training run)
+    d = [r for r in recs if r["metric"] == "draft_model_decode_tokens_per_sec"]
+    assert len(d) == 1, recs
+    d = d[0]
+    assert {
+        "value", "unit", "draft_tokens", "draft_layers", "target_layers",
+        "draft_params_derived", "accept_rate", "windows",
+        "baseline_tokens_per_sec", "speedup_vs_baseline",
+        "identical_to_baseline",
+    } <= d.keys(), d
+    assert d["identical_to_baseline"] is True
+    assert d["draft_params_derived"] is True
+    assert d["draft_layers"] < d["target_layers"], d
+    assert d["accept_rate"] > 0.5, d  # the truncated draft tracked the cycle
+    assert d["speedup_vs_baseline"] >= 1.3, d
+
+    # ISSUE 15: the adaptive record — high-entropy traffic where n-gram
+    # speculation loses; the controller must detect the low accept rate,
+    # disable speculation, and land within 5% of plain decode while
+    # beating the always-on n-gram path
+    a = [
+        r for r in recs
+        if r["metric"] == "adaptive_spec_decode_tokens_per_sec"
+    ]
+    assert len(a) == 1, recs
+    a = a[0]
+    assert {
+        "value", "unit", "plain_tokens_per_sec", "ngram_tokens_per_sec",
+        "ngram_accept_rate", "adaptive_vs_plain",
+        "adaptive_vs_ngram_speedup", "auto_disable_engaged",
+        "effective_k_final", "spec_windows", "identical_to_baseline",
+    } <= a.keys(), a
+    assert a["identical_to_baseline"] is True
+    assert a["auto_disable_engaged"] is True, a
+    assert a["adaptive_vs_plain"] >= 0.95, a
+    assert a["adaptive_vs_ngram_speedup"] > 1.0, a
+
+    # ISSUE 15: the int8-KV record — ~2x+ decode rows per HBM byte vs
+    # the f32 pool, with chunked prefill and prefix reuse byte-identical
+    # on the quantized pool
+    k = [r for r in recs if r["metric"] == "int8_kv_decode_tokens_per_sec"]
+    assert len(k) == 1, recs
+    k = k[0]
+    assert {
+        "value", "unit", "kv_quant", "page_tokens", "pool_pages",
+        "kv_pool_bytes", "kv_pool_bytes_fp", "bytes_ratio", "rows_fp",
+        "dense_equivalent_rows", "rows_per_byte_vs_fp",
+        "chunked_prefill_identical", "prefix_reuse_identical",
+    } <= k.keys(), k
+    assert k["kv_quant"] == "int8"
+    assert k["kv_pool_bytes"] < k["kv_pool_bytes_fp"], k
+    assert k["chunked_prefill_identical"] is True
+    assert k["prefix_reuse_identical"] is True
+    assert k["rows_per_byte_vs_fp"] >= 1.9, k
+
 
 def test_serving_bench_paged_schema(tmp_home):
     proc = _run(

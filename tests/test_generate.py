@@ -374,13 +374,160 @@ def test_int8_scan_layers_and_spec_compose():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
 
 
-def test_int8_rejects_lora_and_double_quantize():
+def test_int8_quantizes_lora_base_keeps_adapters_fp():
+    """ISSUE 15 lifted the old reject-LoRA restriction: a LoRA checkpoint
+    quantizes its FROZEN base kernel to int8 while the adapter deltas
+    (`lora_a`/`lora_b`) stay at checkpoint precision — multi-tenant
+    serving can now stack fp adapters on an int8 base. Double-quantize
+    must still refuse."""
     from polyaxon_tpu.models.quant import quantize_module
 
-    module, params, _ = _setup(lora_rank=2, lora_targets=("q_proj",))
-    with pytest.raises(ValueError, match="LoRA"):
-        quantize_module(module, params)
+    module, params, prompt = _setup(lora_rank=2, lora_targets=("q_proj",))
+    qmodule, qparams, saved = quantize_module(module, params)
+    assert saved > 0
+    base = np.asarray(
+        generate(module, params, prompt, max_new_tokens=8, temperature=0.0)
+    )
+    q = np.asarray(
+        generate(qmodule, qparams, prompt, max_new_tokens=8, temperature=0.0)
+    )
+    agree = (base[:, 5:] == q[:, 5:]).mean()
+    assert agree >= 0.75, f"int8+LoRA greedy agreement {agree}"
+    leaves = jax.tree_util.tree_leaves_with_path(qparams)
+    kinds = {
+        str(p[-1].key): l.dtype
+        for p, l in leaves
+        if "q_proj" in str(p)
+    }
+    # base kernel int8 + scale, adapters untouched fp
+    assert kinds["kernel"] == jnp.int8 and kinds["scale"] == jnp.float32
+    assert kinds["lora_a"] == jnp.float32
+    assert kinds["lora_b"] == jnp.float32
+
     module, params, _ = _setup()
     qmodule, qparams, _ = quantize_module(module, params)
     with pytest.raises(ValueError, match="quant"):
         quantize_module(qmodule, qparams)
+
+
+# ---------- ISSUE 15: draft-model speculation ----------
+
+
+def test_draft_model_spec_byte_identity_greedy():
+    """A layer-truncated draft model proposes, the target verifies:
+    spec_generate stays a byte-identical drop-in for generate() with the
+    model drafter exactly as it is with the n-gram drafter."""
+    from polyaxon_tpu.models.draft import ModelDrafter, build_draft
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup()
+    dmodule, dparams, derived = build_draft(
+        module, params, overrides={"n_layers": 1}
+    )
+    assert derived is True  # same widths → params sliced, not random
+    assert dmodule.cfg.n_layers == 1
+    base = generate(module, params, prompt, max_new_tokens=12,
+                    temperature=0.0)
+    drafter = ModelDrafter(
+        dmodule, dparams, prompt, [5, 5], seeds=[0, 0],
+    )
+    stats = {}
+    out = spec_generate(module, params, prompt, max_new_tokens=12,
+                        draft_tokens=3, temperature=0.0, stats=stats,
+                        drafter=drafter)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+    assert stats["proposed"] > 0 and stats["windows"] >= 1
+
+
+@pytest.mark.slow
+def test_draft_model_spec_byte_identity_sampled_bucketed_eos():
+    """The serving shape through the model drafter: per-row seeds,
+    LEFT-padded rows, eos cutoff. The drafter replays the target's own
+    fold_in(key, g) sample schedule, so sampled streams stay exact."""
+    from polyaxon_tpu.models.draft import ModelDrafter, build_draft
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup()
+    seeds = jnp.asarray([3, 11], jnp.int32)
+    lengths = jnp.asarray([5, 3], jnp.int32)
+    base = generate(module, params, prompt, max_new_tokens=12,
+                    temperature=0.9, top_k=20, eos_id=5, seed=seeds,
+                    prompt_lengths=lengths)
+    dmodule, dparams, _ = build_draft(module, params,
+                                      overrides={"n_layers": 1})
+    drafter = ModelDrafter(
+        dmodule, dparams, prompt, [5, 3], seeds=[3, 11],
+        temperature=0.9, top_k=20,
+    )
+    out = spec_generate(module, params, prompt, max_new_tokens=12,
+                        draft_tokens=4, temperature=0.9, top_k=20,
+                        eos_id=5, seeds=seeds, prompt_lengths=lengths,
+                        drafter=drafter)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+def test_draft_model_random_init_never_changes_bytes():
+    """A randomly initialized draft (the no-trained-checkpoint fallback)
+    is merely slow — acceptance is exact-match, so outputs cannot
+    diverge no matter how bad the proposals are."""
+    from polyaxon_tpu.models.draft import (
+        ModelDrafter, build_draft, init_draft_params,
+    )
+    from polyaxon_tpu.models.spec_decode import spec_generate
+
+    module, params, prompt = _setup()
+    dmodule, _, _ = build_draft(module, params, overrides={"n_layers": 1})
+    dparams = init_draft_params(dmodule, seed=42)
+    base = generate(module, params, prompt, max_new_tokens=10,
+                    temperature=0.0)
+    drafter = ModelDrafter(dmodule, dparams, prompt, [5, 5], seeds=[0, 0])
+    out = spec_generate(module, params, prompt, max_new_tokens=10,
+                        draft_tokens=3, temperature=0.0, drafter=drafter)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+def test_draft_config_pins_vocab_and_defaults_depth():
+    from polyaxon_tpu.models.draft import build_draft
+
+    module, params, _ = _setup()
+    # default draft depth = half the target's layers
+    dmodule, _, derived = build_draft(module, params)
+    assert dmodule.cfg.n_layers == 1 and derived is True
+    with pytest.raises(ValueError, match="tokenizer"):
+        build_draft(module, params, overrides={"vocab_size": 64})
+    with pytest.raises(ValueError, match="unknown draft config"):
+        build_draft(module, params, overrides={"n_lyers": 1})
+
+
+def test_spec_truncation_corrected_accept_rate():
+    """ISSUE 15 satellite: near maxNewTokens the remaining-budget clamp
+    truncates an accepted run — the raw committed count deflates while
+    accepted_judged keeps counting what the verify forward really
+    matched. The two diverge ONLY at that boundary."""
+    from polyaxon_tpu.models.spec_decode import commit_window
+
+    # row 0: all 4 drafts judged correct but only 2 tokens of budget
+    # left → commits 2, raw accepted 1, judged 4, truncated 3.
+    # row 1: plenty of budget, 2 drafts accepted → no truncation.
+    fed = np.tile(np.arange(10, 15, dtype=np.int32), (2, 1))
+    targets = fed.copy()
+    committed, done, remaining, eos_hit, stats = commit_window(
+        fed, targets, accept=np.asarray([4, 2]),
+        remaining=np.asarray([2, 8]), done=[False, False], eos_id=None,
+    )
+    assert [len(c) for c in committed] == [2, 3]
+    assert stats["proposed"] == 8
+    assert stats["accepted"] == 1 + 2
+    assert stats["accepted_judged"] == 4 + 2
+    assert stats["truncated"] == 3, stats
+    assert stats["accepted_judged"] == stats["accepted"] + stats["truncated"]
+    raw = stats["accepted"] / stats["proposed"]
+    corrected = stats["accepted_judged"] / stats["proposed"]
+    assert corrected > raw
+    # away from the budget boundary the two rates are THE SAME figure
+    _, _, _, _, mid = commit_window(
+        fed, targets, accept=np.asarray([4, 2]),
+        remaining=np.asarray([8, 8]), done=[False, False], eos_id=None,
+    )
+    assert mid["truncated"] == 0
+    assert mid["accepted_judged"] == mid["accepted"]

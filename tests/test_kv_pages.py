@@ -375,3 +375,137 @@ def test_paged_decode_identity_ladder(
     scan_layers, pb, nb, pt, chunk, prefix_len, temp, eos
 ):
     _identity_case(scan_layers, pb, nb, pt, chunk, prefix_len, temp, eos)
+
+
+# ----------------------------------------------- ISSUE 15: int8 KV pool
+def test_quantize_kv_roundtrip_and_purity():
+    """quantize_kv is a pure per-(slot, head) transform: scales amax over
+    the head dim only, so the quantized bytes of a vector never depend on
+    which batch/chunk wrote it — the property that keeps chunked prefill,
+    COW and one-shot prefill byte-identical on a quantized pool."""
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models.quant import dequantize_kv, quantize_kv
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 8, 2, 16).astype(np.float32)  # [B, T, nkv, hd]
+    q, s = quantize_kv(jnp.asarray(x))
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    back = np.asarray(dequantize_kv(q, s))
+    # symmetric 127-level quant: error bounded by half a step per element
+    step = np.maximum(np.abs(x).max(-1), 1e-8) / 127.0
+    assert (np.abs(back - x) <= step[..., None] * 0.5 + 1e-7).all()
+    # purity: a slice quantizes to exactly the bytes it got in the batch
+    q1, s1 = quantize_kv(jnp.asarray(x[1:2, 3:5]))
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q)[1:2, 3:5])
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s)[1:2, 3:5])
+    # the zero vector quantizes to zeros, not NaNs
+    q0, s0 = quantize_kv(jnp.zeros((2, 16)))
+    assert np.asarray(q0).sum() == 0 and np.isfinite(np.asarray(s0)).all()
+
+
+def test_int8_pool_structure_and_bytes(tiny_model):
+    """The quantized pool really is int8 on the wire, carries one f32
+    scale per (slot, kv head), and its byte footprint matches the
+    kv_pool_bytes formula the server budgets admission with."""
+    import jax
+
+    from polyaxon_tpu.models.generate import make_paged_cache
+    from polyaxon_tpu.models.quant import kv_pool_bytes
+
+    module, params = tiny_model
+    lay_q = PagedKVLayout(page_tokens=8, pool_pages=16, kv_quant="int8")
+    lay_fp = PagedKVLayout(page_tokens=8, pool_pages=16)
+    cache_q = make_paged_cache(module, params, lay_q)
+    cache_fp = make_paged_cache(module, params, lay_fp)
+
+    leaves_q = jax.tree_util.tree_leaves_with_path(cache_q)
+    kinds = {str(p[-1].key): l.dtype for p, l in leaves_q}
+    import jax.numpy as jnp
+
+    assert kinds["cached_key"] == jnp.int8
+    assert kinds["cached_value"] == jnp.int8
+    assert kinds["cached_key_scale"] == jnp.float32
+    assert kinds["cached_value_scale"] == jnp.float32
+    # scale leaves drop the head_dim axis: one scale per slot per head
+    shapes = {str(p[-1].key): l.shape for p, l in leaves_q}
+    assert shapes["cached_key_scale"] == shapes["cached_key"][:-1]
+
+    def nbytes(c):
+        return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(c))
+
+    cfg = module.cfg
+    hd = cfg.dim // cfg.n_heads
+    assert nbytes(cache_q) == kv_pool_bytes(
+        lay_q, cfg.n_layers, cfg.n_kv_heads, hd
+    )
+    fp_itemsize = jax.tree.leaves(cache_fp)[0].dtype.itemsize
+    assert nbytes(cache_fp) == kv_pool_bytes(
+        lay_fp, cfg.n_layers, cfg.n_kv_heads, hd,
+        kv_dtype_bytes=fp_itemsize,
+    )
+    # the capacity claim at this geometry: >= 1.9x rows per byte
+    assert nbytes(cache_fp) / nbytes(cache_q) >= 1.9
+
+
+def test_int8_pool_chunked_prefill_matches_one_shot(tiny_model):
+    """Write-order independence on the QUANTIZED pool: prefill delivered
+    in two slices must leave decode byte-identical to one-shot prefill —
+    the same contract the fp pool honors, now with quantize-on-write."""
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models.generate import (
+        jit_paged_chunk,
+        jit_paged_prefill,
+        jit_paged_prefill_chunk,
+        make_paged_cache,
+    )
+
+    module, params = tiny_model
+    lay = PagedKVLayout(page_tokens=4, pool_pages=32, kv_quant="int8")
+    B, P, nb = 2, 8, 6
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, 128, size=(B, P)).astype(np.int32)
+    seeds = jnp.asarray([7, 11], jnp.int32)
+    pads = jnp.zeros((B,), jnp.int32)
+    n_pages = -(-(P + nb) // 4)
+    tables = jnp.asarray(
+        1 + np.arange(B * n_pages, dtype=np.int32).reshape(B, n_pages)
+    )
+
+    def decode(cache, first):
+        cf = jit_paged_chunk(module, steps=nb - 1, kv_layout=lay,
+                             prefix_len=0, temperature=0.8, top_k=40,
+                             eos_id=None)
+        cache, toks, _ = cf(
+            params, cache, first, jnp.zeros((B,), bool), pads, tables,
+            seeds, jnp.asarray(P, jnp.int32), jnp.asarray(1, jnp.int32),
+        )
+        return np.concatenate(
+            [np.asarray(first).reshape(B, 1), np.asarray(toks)], axis=1
+        )
+
+    # one-shot
+    cache = make_paged_cache(module, params, lay)
+    pf = jit_paged_prefill(module, kv_layout=lay, prefix_len=0,
+                           temperature=0.8, top_k=40)
+    cache, first = pf(params, cache, jnp.asarray(prompt), pads, tables,
+                      seeds)
+    one = decode(cache, first)
+
+    # two slices: 5 tokens then the ragged 3-token final
+    cache = make_paged_cache(module, params, lay)
+    zero_prefix = jnp.zeros((B,), jnp.int32)
+    c1 = jit_paged_prefill_chunk(module, kv_layout=lay, temperature=0.8,
+                                 top_k=40, final=False)
+    cache = c1(params, cache, jnp.asarray(prompt[:, :5]), pads,
+               zero_prefix, tables, seeds, jnp.asarray(0, jnp.int32))
+    c2 = jit_paged_prefill_chunk(module, kv_layout=lay, temperature=0.8,
+                                 top_k=40, final=True)
+    cache, first2 = c2(params, cache, jnp.asarray(prompt[:, 5:]), pads,
+                       zero_prefix, tables, seeds,
+                       jnp.asarray(5, jnp.int32))
+    two = decode(cache, first2)
+
+    np.testing.assert_array_equal(one, two)
