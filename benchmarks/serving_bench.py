@@ -149,28 +149,18 @@ def _post(url: str, body: dict, timeout: float = 300.0) -> dict:
 
 
 def make_traffic(n_requests: int, seed: int) -> list[dict]:
-    """Deterministic randomized request mix. Lengths are drawn from a
-    modest pool of distinct values — enough variety that the exact-shape
-    baseline keeps recompiling, small enough that the full run finishes
-    on CPU (every distinct (P, new) pair is ~one XLA compile there)."""
-    rng = random.Random(seed)
-    lengths = rng.sample(range(4, 49), 12)
-    news = [4, 6, 8]
-    out = []
-    for i in range(n_requests):
-        plen = rng.choice(lengths)
-        out.append(
-            {
-                "tokens": [
-                    [rng.randrange(MODEL_CFG["vocab_size"]) for _ in range(plen)]
-                ],
-                "maxNewTokens": rng.choice(news),
-                "temperature": 0.8,
-                "topK": 40,
-                "seed": i,
-            }
-        )
-    return out
+    """Deterministic randomized request mix, drawn from the scenario
+    engine's seeded `bench_mix` trace generator (ISSUE 16): a modest
+    pool of distinct prompt lengths — enough variety that the
+    exact-shape baseline keeps recompiling, small enough that the full
+    run finishes on CPU — so the bench workload is a replayable trace
+    (`trace_seed` in the records) instead of ad-hoc rng calls."""
+    from polyaxon_tpu.scenarios.traces import bench_mix, body_for
+
+    return [
+        body_for(rec, MODEL_CFG["vocab_size"])
+        for rec in bench_mix(seed, n=n_requests)
+    ]
 
 
 def build_server(batching: bool, max_batch: int, max_wait_ms: float,
@@ -1204,6 +1194,7 @@ def main(argv=None):
             make_traffic(args.requests, args.seed), args.clients,
             args.max_batch, args.max_wait_ms, args.repeats, args.seed,
         )
+        rec["trace_seed"] = args.seed
         print(json.dumps(rec), flush=True)
         # the record must demonstrate the observability plane is near
         # free on the routed path AND that it actually ran (federated
@@ -1218,6 +1209,7 @@ def main(argv=None):
             make_traffic(args.requests, args.seed), args.clients,
             args.max_batch, args.max_wait_ms, args.repeats,
         )
+        rec["trace_seed"] = args.seed
         print(json.dumps(rec), flush=True)
         # the record must demonstrate tracing is effectively free; only
         # the smoke configuration gates (full runs just report)
@@ -1245,6 +1237,7 @@ def main(argv=None):
             mode, traffic, args.clients, args.max_batch, args.max_wait_ms,
             kv_pool_pages=args.kv_pool_pages,
         )
+        recs[mode]["trace_seed"] = args.seed
         print(json.dumps(recs[mode]), flush=True)
     if len(recs) == 2 and recs["per_request"]["value"] > 0:
         print(

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import random
 import sys
 import threading
 import time
@@ -74,16 +73,19 @@ def _post(url: str, body: dict, timeout: float) -> tuple[int, dict]:
         return e.code, payload
 
 
-def _body(rng: random.Random, seed: int) -> dict:
-    return {
-        "tokens": [
-            [rng.randrange(MODEL_CFG["vocab_size"]) for _ in range(PROMPT_LEN)]
-        ],
-        "maxNewTokens": MAX_NEW,
-        "temperature": 0.8,
-        "topK": 40,
-        "seed": seed,
-    }
+def _bodies(trace_seed: int, n: int) -> list[dict]:
+    """Request bodies from the scenario engine's seeded `single_shape`
+    trace generator (ISSUE 16): one fixed shape — one bucket, one
+    compile — so capacity stays a pure decode-rate property, and the
+    workload is a replayable trace (`trace_seed` in the record)."""
+    from polyaxon_tpu.scenarios.traces import body_for, single_shape
+
+    return [
+        body_for(rec, MODEL_CFG["vocab_size"])
+        for rec in single_shape(
+            trace_seed, n=n, prompt_len=PROMPT_LEN, max_new=MAX_NEW
+        )
+    ]
 
 
 def build_server(max_batch: int, max_queue: int, breaker_threshold: int):
@@ -117,12 +119,13 @@ def build_server(max_batch: int, max_queue: int, breaker_threshold: int):
     )
 
 
-def calibrate(url: str, rng: random.Random, max_batch: int) -> float:
+def calibrate(url: str, trace_seed: int, max_batch: int) -> float:
     """Seconds one full decode group takes, measured after the compile
     is warm: a max_batch-row body is exactly one coalesced group."""
-    warm = _body(rng, seed=0)
+    # a distinct trace stream so calibration prompts differ from the
+    # driven ones (same role the shared rng draws played before)
+    warm, body = _bodies(trace_seed + 999_331, n=2)
     _post(url, warm, timeout=300.0)  # pays the XLA compile
-    body = _body(rng, seed=1)
     body["tokens"] = body["tokens"] * max_batch
     best = float("inf")
     for _ in range(3):
@@ -137,7 +140,6 @@ def calibrate(url: str, rng: random.Random, max_batch: int) -> float:
 
 
 def drive(args) -> dict:
-    rng = random.Random(args.seed)
     server = build_server(
         args.max_batch, args.max_queue, args.breaker_threshold
     )
@@ -160,7 +162,7 @@ def drive(args) -> dict:
     server._coalescer._execute = timed_execute
     port = server.start(port=0)
     url = f"http://127.0.0.1:{port}/generate"
-    group_s = calibrate(url, rng, args.max_batch)
+    group_s = calibrate(url, args.seed, args.max_batch)
     recording.set()  # calibration/compile groups stay out of the sample
     capacity_rps = args.max_batch / group_s
     offered_rps = capacity_rps * args.overload
@@ -169,8 +171,8 @@ def drive(args) -> dict:
     deadline_ms = max(200.0, 3.0 * group_s * 1e3)
 
     bodies = [
-        {**_body(rng, seed=i), "deadlineMs": deadline_ms}
-        for i in range(args.requests)
+        {**body, "deadlineMs": deadline_ms}
+        for body in _bodies(args.seed, args.requests)
     ]
     offsets = [i / offered_rps for i in range(args.requests)]
     lock = threading.Lock()
@@ -266,6 +268,8 @@ def drive(args) -> dict:
         "breaker": stats.get("breaker"),
         "platform": device.platform,
         "device_kind": device.device_kind,
+        "trace_seed": args.seed,
+        "trace_generator": "single_shape",
     }
     if first_error:
         rec["first_error"] = first_error[0]

@@ -276,6 +276,28 @@ class FaultPlan:
                     "delay_ms": delay_ms},
         )
 
+    @classmethod
+    def replica_kill_midsoak(
+        cls, seed: int, window: int, replicas: int = 2
+    ) -> "FaultPlan":
+        """A whole serving replica dies mid-soak (ISSUE 16): the scenario
+        runner's tick loop fires `scenario.replica_kill` once per tick
+        and kills the seed-chosen replica slot at the seed-chosen tick
+        (the middle half of the window, so the soak is warm on both
+        sides). The ReplicaSetManager monitor must restart it and the
+        router must retry/failover around the outage — zero hung
+        requests, zero leaked KV pages."""
+        rng = random.Random(f"replica_kill_midsoak:{seed}")
+        lo = max(1, window // 4)
+        k = rng.randrange(lo, max(lo + 1, (3 * window) // 4))
+        slot = rng.randrange(max(1, replicas))
+        return cls(
+            [Fault("scenario.replica_kill", "kill", at=k,
+                   message=f"chaos: replica r{slot} killed at tick {k}")],
+            seed=seed,
+            params={"kill_tick": k, "kill_slot": slot, "window": window},
+        )
+
     # ------------------------------------------- event-log store scenarios
     # The store points (ISSUE 11): `store.append` fires right before a
     # batch's frames hit the run's live segment (ctx: run, seq, path),

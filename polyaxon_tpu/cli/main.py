@@ -1290,6 +1290,81 @@ def fleet_init(topology, chips):
     click.echo(f"fleet configured: {json.dumps(cfg)}")
 
 
+@cli.group()
+def scenario():
+    """Scenario engine: trace-driven replay, chaos, soak simulation.
+
+    Named scenarios compose a seeded traffic trace, an optional chaos
+    ingredient (replica kill, tiny KV pool, small queue), and
+    declarative assertions (max shed rate, p99 bound, zero hung, zero
+    leaked KV pages). `run` drives them against a live in-process
+    router+replica rig (mode=real) or the discrete-event serving twin
+    (mode=twin, million-user soaks in seconds)."""
+
+
+@scenario.command("ls")
+def scenario_ls():
+    """Named scenarios, one JSON line each."""
+    from ..scenarios.registry import scenario_table
+
+    for row in scenario_table():
+        click.echo(json.dumps(row))
+
+
+@scenario.command("run")
+@click.argument("name")
+@click.option("--mode", default=None,
+              type=click.Choice(["real", "twin"]),
+              help="real = live router+replica rig; twin = discrete-event "
+              "simulation (default: real, or twin for twin-only scenarios)")
+@click.option("--smoke", is_flag=True,
+              help="small CI configuration of the scenario's trace")
+@click.option("--seed", default=None, type=int,
+              help="override the scenario's trace/chaos seed")
+@click.option("--replicas", default=2, type=int,
+              help="rig size for mode=real")
+@click.option("--out", default=None, type=click.Path(),
+              help="write the full result JSON here (stdout stays a "
+              "one-line summary + assertion verdicts)")
+def scenario_run(name, mode, smoke, seed, replicas, out):
+    """Run one named scenario and evaluate its assertions (exit 1 on
+    any failed assertion)."""
+    from ..scenarios.registry import SCENARIOS, run_scenario
+    from ..utils.jax_platform import apply_platform_env
+
+    if name not in SCENARIOS:
+        raise click.ClickException(
+            f"unknown scenario {name!r} "
+            f"(have: {', '.join(sorted(SCENARIOS))})"
+        )
+    scn = SCENARIOS[name]
+    if mode is None:
+        mode = "twin" if scn.twin_only else "real"
+    if mode == "real":
+        apply_platform_env()  # before any jax init in the rig
+    try:
+        result = run_scenario(
+            name, mode=mode, smoke=smoke, seed=seed, replicas=replicas
+        )
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2, default=str)
+    summary = dict(result["summary"])
+    summary.pop("shed_reasons", None)
+    click.echo(json.dumps({
+        "scenario": name, "mode": mode, "pass": result["pass"],
+        **{k: v for k, v in summary.items()
+           if k in ("offered", "ok", "shed", "disconnected", "error",
+                    "hung", "shed_rate")},
+    }))
+    for v in result["assertions"]:
+        click.echo(json.dumps(v))
+    if not result["pass"]:
+        raise SystemExit(1)
+
+
 @fleet.command("show")
 def fleet_show():
     """Inventory, reservations, and per-project usage (the /fleetz body)."""

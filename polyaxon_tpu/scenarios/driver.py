@@ -1,0 +1,257 @@
+"""Open-loop trace replay against the real serving stack.
+
+The driver fires each trace record at `epoch + record.at` regardless of
+how earlier requests fared — open-loop, so overload scenarios actually
+overload instead of self-throttling like a closed-loop client would.
+Every request gets a per-request outcome in the ledger (HTTP status,
+shed reason, client-measured TTFT for streamed requests, end-to-end
+latency, whether a scripted disconnect was honored, the X-Request-Id
+echoed back), and the report enforces the hard invariant at drain:
+ZERO hung requests — every fired request resolved to SOME outcome
+within its timeout.
+
+Records with `disconnect_after_ms` stream (`POST /generate?stream=1`)
+and abandon the connection that long after the first SSE byte — an
+abrupt socket close, exactly what a vanished client looks like to the
+server. The serving stack must notice (satellite 1: cancellation +
+prompt KV release, `serving_client_disconnects_total`).
+
+Rule 13 (scripts/lint_telemetry.py): no raw clocks here. Timing reads
+`telemetry.now()`; schedule delays use `threading.Event.wait`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Iterable, Optional
+from urllib.parse import urlsplit
+
+from ..telemetry import now as _now
+from ..telemetry import quantile
+from .traces import TraceRequest, body_for
+
+
+@dataclasses.dataclass
+class Outcome:
+    """One request's ledger entry."""
+
+    i: int
+    rid: str
+    status: int = 0
+    ok: bool = False
+    reason: Optional[str] = None  # shed reason / error class
+    latency_ms: Optional[float] = None
+    ttft_ms: Optional[float] = None  # client-measured, streamed requests
+    tokens: int = 0  # generated tokens delivered to this client
+    disconnected: bool = False  # the scripted disconnect was executed
+    rid_echoed: bool = False  # X-Request-Id came back on the response
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    outcomes: list[Outcome]
+    offered: int
+    duration_s: float
+
+    def summary(self) -> dict:
+        by = {"ok": 0, "shed_503": 0, "deadline_504": 0, "error": 0,
+              "disconnected": 0}
+        reasons: dict[str, int] = {}
+        lat, ttft = [], []
+        hung = 0
+        for o in self.outcomes:
+            if o.status == 0:
+                hung += 1
+            elif o.disconnected:
+                by["disconnected"] += 1
+            elif o.status == 200:
+                by["ok"] += 1
+            elif o.status == 503:
+                by["shed_503"] += 1
+            elif o.status == 504:
+                by["deadline_504"] += 1
+            else:
+                by["error"] += 1
+            if o.reason:
+                reasons[o.reason] = reasons.get(o.reason, 0) + 1
+            if o.latency_ms is not None and o.status == 200:
+                lat.append(o.latency_ms)
+            if o.ttft_ms is not None:
+                ttft.append(o.ttft_ms)
+        hung += self.offered - len(self.outcomes)
+        shed = by["shed_503"] + by["deadline_504"]
+        lat.sort()
+        ttft.sort()
+        return {
+            "mode": "real",
+            "offered": self.offered,
+            **by,
+            "shed": shed,
+            "shed_reasons": reasons,
+            "shed_rate": round(shed / self.offered, 4) if self.offered else 0.0,
+            "hung": hung,
+            "latency_ms": {
+                "p50": quantile(lat, 0.5),
+                "p99": quantile(lat, 0.99),
+                "mean": (sum(lat) / len(lat)) if lat else None,
+            },
+            "ttft_ms": {
+                "p50": quantile(ttft, 0.5),
+                "p99": quantile(ttft, 0.99),
+            },
+            "duration_s": round(self.duration_s, 3),
+        }
+
+
+def _post(url: str, body: dict, rid: str, timeout: float) -> tuple[int, dict, bool]:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", "X-Request-Id": rid},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            echoed = r.headers.get("X-Request-Id") == rid
+            return r.status, json.loads(r.read()), echoed
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except Exception:  # noqa: BLE001 — a shed body is best-effort JSON
+            payload = {}
+        return e.code, payload, e.headers.get("X-Request-Id") == rid
+
+
+def _stream(base: str, body: dict, rid: str, timeout: float,
+            disconnect_after_ms: Optional[float],
+            outcome: Outcome) -> None:
+    """Streamed request over a raw connection so a scripted disconnect
+    can abandon the socket mid-stream, the way a vanished client does."""
+    parts = urlsplit(base)
+    conn = http.client.HTTPConnection(
+        parts.hostname, parts.port, timeout=timeout
+    )
+    t0 = _now()
+    try:
+        conn.request(
+            "POST", "/generate?stream=1", body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json", "X-Request-Id": rid},
+        )
+        resp = conn.getresponse()
+        outcome.status = resp.status
+        outcome.rid_echoed = resp.getheader("X-Request-Id") == rid
+        if resp.status != 200:
+            payload = {}
+            try:
+                payload = json.loads(resp.read())
+            except Exception:  # noqa: BLE001
+                pass
+            outcome.reason = payload.get("reason")
+            outcome.latency_ms = (_now() - t0) * 1e3
+            return
+        first_byte_t: Optional[float] = None
+        for raw in resp:
+            if not raw.startswith(b"data: "):
+                continue
+            if first_byte_t is None:
+                first_byte_t = _now()
+                outcome.ttft_ms = (first_byte_t - t0) * 1e3
+            ev = json.loads(raw[6:])
+            outcome.tokens += len(ev.get("tokens") or ())
+            if ev.get("error") and "row" in ev:
+                outcome.reason = "stream_error"
+            if (
+                disconnect_after_ms is not None
+                and (_now() - first_byte_t) * 1e3 >= disconnect_after_ms
+            ):
+                # the scripted abandon: close abruptly mid-stream
+                outcome.disconnected = True
+                if conn.sock is not None:
+                    conn.sock.close()
+                break
+        outcome.ok = outcome.reason is None
+        outcome.latency_ms = (_now() - t0) * 1e3
+    finally:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def replay(
+    records: Iterable[TraceRequest],
+    base_url: str,
+    *,
+    vocab_size: int,
+    time_scale: float = 1.0,
+    timeout_s: float = 60.0,
+    rid_prefix: str = "scn",
+) -> ReplayReport:
+    """Replay a trace open-loop against `base_url` (a router or replica).
+
+    `time_scale` compresses the schedule (2.0 = twice as fast). The
+    returned report's `summary()["hung"]` MUST be zero — a request that
+    neither completed nor errored within `timeout_s` is the one failure
+    mode nothing downstream can excuse."""
+    records = list(records)
+    gen_url = base_url.rstrip("/") + "/generate"
+    outcomes: list[Outcome] = []
+    lock = threading.Lock()
+    pacer = threading.Event()  # never set: pure bounded wait
+    epoch = _now() + 0.05
+
+    def fire(rec: TraceRequest) -> None:
+        rid = f"{rid_prefix}-{rec.i:07d}"
+        o = Outcome(i=rec.i, rid=rid)
+        delay = epoch + rec.at / max(1e-9, time_scale) - _now()
+        if delay > 0:
+            pacer.wait(delay)
+        body = body_for(rec, vocab_size)
+        t0 = _now()
+        try:
+            if rec.disconnect_after_ms is not None:
+                _stream(base_url, body, rid, timeout_s,
+                        rec.disconnect_after_ms, o)
+            else:
+                code, payload, echoed = _post(gen_url, body, rid, timeout_s)
+                o.status, o.rid_echoed = code, echoed
+                o.latency_ms = (_now() - t0) * 1e3
+                if code == 200:
+                    o.ok = True
+                    o.tokens = sum(
+                        max(0, len(row) - rec.prompt_len)
+                        for row in payload.get("tokens") or ()
+                    )
+                else:
+                    o.reason = payload.get("reason")
+        except Exception as e:  # noqa: BLE001 — the ledger records it
+            o.status = o.status or 599
+            o.reason = type(e).__name__
+            o.latency_ms = (_now() - t0) * 1e3
+        with lock:
+            outcomes.append(o)
+
+    threads = [
+        threading.Thread(target=fire, args=(rec,), daemon=True)
+        for rec in records
+    ]
+    t_start = _now()
+    for t in threads:
+        t.start()
+    horizon = (
+        (records[-1].at / max(1e-9, time_scale)) if records else 0.0
+    ) + timeout_s + 10.0
+    deadline = t_start + horizon
+    for t in threads:
+        t.join(max(0.1, deadline - _now()))
+    # threads still alive at drain ARE hung requests: their outcomes are
+    # missing from the ledger and summary() counts the gap
+    return ReplayReport(
+        outcomes=list(outcomes),
+        offered=len(records),
+        duration_s=_now() - t_start,
+    )

@@ -1,0 +1,526 @@
+"""Named scenarios: trace + chaos ingredients + declarative assertions.
+
+A `Scenario` composes
+  * a seeded trace (a `traces.GENERATORS` entry plus params, with a
+    smaller `smoke_params` overlay for CI),
+  * an optional chaos ingredient (a `FaultPlan` against the scenario
+    runner's own injection point, e.g. `scenario.replica_kill`, or the
+    process-global serving points the replicas already instrument),
+  * serving-config overrides (e.g. a tiny `kv_pool_pages` pool is the
+    KV-exhaustion ingredient, a small `max_queue` the overload one),
+  * declarative `Assertions` (max shed rate, p99 bound, SLO burn, zero
+    hung requests, zero leaked KV pages).
+
+`run_scenario(name, mode="real"|"twin")` replays the scenario either
+against a live router+replicas rig (built here exactly like
+tests/test_router.py builds one, or passed in for reuse) or through the
+discrete-event twin — same trace, same seed, same assertion schema, so
+`benchmarks/scenario_bench.py` can pin the twin's predictions against
+the real stack (`sim_vs_real_calibration_error`).
+
+Rule 13: no raw clocks — waits go through `threading.Event.wait`,
+measurements through `telemetry.now()`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import urllib.request
+from typing import Optional
+
+from ..chaos.plan import FaultPlan
+from ..telemetry import parse_prometheus_text
+from .driver import replay
+from .traces import generate
+from .twin import PhaseCosts, ServingTwin, TwinConfig
+
+# the rig's model: tiny transformer, seq_len 128 so prompt+new always
+# fits, vocab 256 (trace prompt ids derive mod vocab_size)
+RIG_MODEL_CFG = {
+    "preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+    "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256,
+}
+_CHAOS_TICK_S = 0.1  # the scenario runner's chaos-clock granularity
+
+
+@dataclasses.dataclass(frozen=True)
+class Assertions:
+    """Declarative pass/fail bounds, evaluated identically for real and
+    twin runs (None disables a bound). `zero_hung` and
+    `zero_leaked_pages` are the two hard invariants every scenario
+    keeps on."""
+
+    max_shed_rate: float = 1.0
+    p99_ms: Optional[float] = None
+    max_error_rate: Optional[float] = None
+    max_slo_burn: Optional[float] = None
+    min_completed: int = 1
+    min_disconnects: int = 0
+    zero_hung: bool = True
+    zero_leaked_pages: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    description: str
+    generator: str
+    params: dict
+    assertions: Assertions
+    smoke_params: dict = dataclasses.field(default_factory=dict)
+    serving_overrides: dict = dataclasses.field(default_factory=dict)
+    chaos: Optional[str] = None  # "replica_kill" | None
+    twin_config: dict = dataclasses.field(default_factory=dict)
+    twin_only: bool = False
+    seed: int = 0
+    time_scale: float = 1.0
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def _register(s: Scenario) -> Scenario:
+    SCENARIOS[s.name] = s
+    return s
+
+
+_register(Scenario(
+    name="diurnal_soak",
+    description="Sinusoidal diurnal load with heavy-tailed lengths and "
+                "a skewed tenant mix — the long-soak baseline.",
+    generator="diurnal",
+    params=dict(n=240, duration_s=24.0, base_rps=10.0, max_prompt=24),
+    smoke_params=dict(n=32, duration_s=4.0, base_rps=8.0, max_prompt=24),
+    assertions=Assertions(
+        # p99 bound tolerates the trace's cold head: the first arrivals
+        # pay XLA compiles (~20s on the 1-core CI box), which is host
+        # speed, not serving behavior — the bound catches unbounded
+        # queue waits, not compile time
+        max_shed_rate=0.2, p99_ms=30_000.0, max_error_rate=0.0,
+        max_slo_burn=20.0, min_completed=8,
+    ),
+))
+
+_register(Scenario(
+    name="burst_overload",
+    description="Correlated thundering-herd bursts over a Poisson base "
+                "against a deliberately small admission queue — sheds "
+                "are expected, hangs are not.",
+    generator="bursts",
+    params=dict(n=200, duration_s=12.0, base_rps=10.0, burst_factor=10.0,
+                n_bursts=3, burst_len_s=1.5, max_prompt=24),
+    smoke_params=dict(n=32, duration_s=3.0, base_rps=8.0, burst_factor=10.0,
+                      n_bursts=1, burst_len_s=1.0, max_prompt=24),
+    serving_overrides=dict(max_queue=8),
+    assertions=Assertions(
+        max_shed_rate=0.9, max_error_rate=0.0, min_completed=4,
+    ),
+    twin_config=dict(max_queue=8),
+))
+
+_register(Scenario(
+    name="high_entropy_flood",
+    description="Adversarial flood of unique uniform-random prompts at "
+                "over-capacity rate plus a starved KV pool — exercises "
+                "queue AND kv_pages shedding; goodput over throughput.",
+    generator="flood",
+    params=dict(n=160, rps=50.0, prompt_len=24, max_new=12),
+    smoke_params=dict(n=28, rps=40.0, prompt_len=24, max_new=12),
+    serving_overrides=dict(max_queue=8, kv_pool_pages=48),
+    assertions=Assertions(
+        max_shed_rate=0.95, max_error_rate=0.0, min_completed=2,
+    ),
+    twin_config=dict(max_queue=8, kv_pool_pages=48),
+))
+
+_register(Scenario(
+    name="replica_kill_midsoak",
+    description="A seed-chosen replica dies mid-soak; the monitor "
+                "restarts it and the router retries around the outage — "
+                "zero hung requests, zero leaked pages, no client-visible "
+                "errors.",
+    generator="diurnal",
+    params=dict(n=160, duration_s=16.0, base_rps=10.0, max_prompt=24),
+    smoke_params=dict(n=36, duration_s=6.0, base_rps=6.0, max_prompt=24),
+    chaos="replica_kill",
+    assertions=Assertions(
+        max_shed_rate=0.5, max_error_rate=0.10, min_completed=8,
+    ),
+))
+
+_register(Scenario(
+    name="disconnect_storm",
+    description="Long streamed generations where half the clients vanish "
+                "mid-stream — the server must cancel the rows, release "
+                "their pages promptly, and count the disconnects.",
+    generator="disconnect_storm",
+    params=dict(n=60, rps=8.0, disconnect_frac=0.5, max_new=48),
+    smoke_params=dict(n=16, rps=5.0, disconnect_frac=0.5, max_new=48),
+    assertions=Assertions(
+        max_shed_rate=0.3, max_error_rate=0.0, min_completed=4,
+        min_disconnects=1,
+    ),
+))
+
+_register(Scenario(
+    name="million_user_soak",
+    description="A million-request, two-hour diurnal soak through the "
+                "discrete-event twin — seconds of wall time on the CI "
+                "box, impossible to drive for real there.",
+    generator="diurnal",
+    params=dict(n=1_000_000, duration_s=7200.0, base_rps=160.0,
+                max_prompt=24),
+    smoke_params=dict(n=1_000_000, duration_s=7200.0, base_rps=160.0,
+                      max_prompt=24),
+    twin_only=True,
+    twin_config=dict(replicas=8, max_batch=8, max_queue=64,
+                     kv_pool_pages=256, kv_page_tokens=8),
+    assertions=Assertions(max_shed_rate=0.05, min_completed=500_000),
+))
+
+
+# ------------------------------------------------------------------ rig
+class Rig:
+    """A live 2+-replica router rig, shaped exactly like the
+    tests/test_router.py fixture. Build once, reuse across scenarios
+    (scenario_bench does); `stop()` tears the whole stack down."""
+
+    def __init__(self, mgr, router, port: int, replicas: int):
+        self.mgr = mgr
+        self.router = router
+        self.port = port
+        self.replicas = replicas
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def replica_metricsz(self) -> list[str]:
+        out = []
+        for url in self.mgr.endpoints():
+            try:
+                out.append(_http_text(url + "/metricsz"))
+            except Exception:  # noqa: BLE001 — a dead replica scrapes empty
+                out.append("")
+        return out
+
+    def stop(self) -> None:
+        self.router.stop()
+        self.mgr.stop()
+
+
+def build_rig(replicas: int = 2, overrides: Optional[dict] = None,
+              slos: Optional[list] = None) -> Rig:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import build_model
+    from ..retry import RetryPolicy
+    from ..serving.batching import ServingConfig
+    from ..serving.replicas import InProcessReplica, ReplicaSetManager
+    from ..serving.router import P2CBalancer, Router
+    from ..serving.server import ModelServer
+
+    bundle = build_model("transformer_lm", RIG_MODEL_CFG)
+    params = bundle.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )["params"]
+    cfg = ServingConfig(**{
+        "max_batch": 4, "max_wait_ms": 2.0, "kv_page_tokens": 8,
+        "kv_pool_pages": 96, "stream_chunk_tokens": 4,
+        # prefix_cache off so `serving_kv_pages_used == 0` at drain IS
+        # the zero-leak invariant (a warm prefix cache holds pages on
+        # purpose and would need baseline bookkeeping instead)
+        "prefix_cache": False,
+        "request_timeout_s": 60.0,
+        **(overrides or {}),
+    })
+    if slos is None:
+        slos = [{"name": "availability", "kind": "availability",
+                 "objective": 0.99}]
+
+    def _server():
+        return ModelServer(
+            bundle.module, params, model_name="scenario-rig", config=cfg,
+            slos=slos,
+        )
+
+    mgr = ReplicaSetManager(
+        lambda i: InProcessReplica(_server),
+        replicas=replicas,
+        retry=RetryPolicy(max_retries=3, backoff=0.05),
+        monitor_interval_s=0.1,
+    )
+    router = Router(
+        mgr.endpoints, balancer=P2CBalancer(seed=7), poll_interval_s=0.2
+    )
+    mgr.attach_router(router)
+    mgr.start()
+    port = router.start("127.0.0.1", 0)
+    return Rig(mgr, router, port, replicas)
+
+
+def _http_text(url: str, timeout: float = 10.0) -> str:
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def _sum_metric(texts: list[str], name: str) -> float:
+    return sum(parse_prometheus_text(t).value(name, 0.0) for t in texts)
+
+
+def _wait_drained(rig: Rig, budget_s: float = 20.0) -> list[str]:
+    """Poll the replicas until queues are empty and every KV page is
+    back (or the budget runs out); returns the final scrapes. A fully
+    drained replica still reports one used page — the KV manager's
+    permanently-allocated scratch page."""
+    waiter = threading.Event()
+    texts: list[str] = []
+    for _ in range(max(1, int(budget_s / 0.2))):
+        texts = rig.replica_metricsz()
+        busy = any(
+            parse_prometheus_text(t).value("serving_queue_depth", 0.0) > 0
+            or parse_prometheus_text(t).value("serving_kv_pages_used", 0.0) > 1
+            for t in texts if t
+        )
+        if not busy and any(texts):
+            break
+        waiter.wait(0.2)
+    return texts
+
+
+# ------------------------------------------------------------ evaluation
+def evaluate(a: Assertions, summary: dict, metrics: dict) -> list[dict]:
+    """Assertion verdicts for one run; identical schema for real and
+    twin modes so calibration can diff them."""
+    out = []
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        out.append({"assertion": name, "ok": bool(ok), "detail": detail})
+
+    if a.zero_hung:
+        check("zero_hung", summary["hung"] == 0,
+              f"hung={summary['hung']}")
+    if a.zero_leaked_pages:
+        leaked = metrics.get("kv_pages_leaked", 0)
+        check("zero_leaked_kv_pages", leaked == 0, f"leaked={leaked}")
+    check("max_shed_rate", summary["shed_rate"] <= a.max_shed_rate,
+          f"shed_rate={summary['shed_rate']} <= {a.max_shed_rate}")
+    if a.p99_ms is not None:
+        p99 = summary["latency_ms"]["p99"]
+        check("p99_ms", p99 is None or p99 <= a.p99_ms,
+              f"p99={p99} <= {a.p99_ms}")
+    if a.max_error_rate is not None:
+        rate = summary["error"] / max(1, summary["offered"])
+        check("max_error_rate", rate <= a.max_error_rate,
+              f"error_rate={round(rate, 4)} <= {a.max_error_rate}")
+    if a.max_slo_burn is not None and metrics.get("slo_burn") is not None:
+        check("max_slo_burn", metrics["slo_burn"] <= a.max_slo_burn,
+              f"burn={metrics['slo_burn']} <= {a.max_slo_burn}")
+    completed = summary.get("ok", 0) + summary.get("disconnected", 0)
+    check("min_completed", completed >= a.min_completed,
+          f"completed={completed} >= {a.min_completed}")
+    if a.min_disconnects:
+        dc = metrics.get("client_disconnects", summary.get("disconnected", 0))
+        check("min_disconnects", dc >= a.min_disconnects,
+              f"disconnects={dc} >= {a.min_disconnects}")
+    return out
+
+
+def calibration_error(twin_summary: dict, real_summary: dict) -> float:
+    """The pinned twin-vs-real disagreement: max of the absolute
+    shed-rate gap and the relative mean-latency gap. Means, not p99s —
+    at calibration scale (dozens of requests on a noisy 1-core CI box)
+    a p99 is one sample, and pinning noise would make the gate
+    meaningless. p99s still ride along in the bench record."""
+    shed_gap = abs(twin_summary["shed_rate"] - real_summary["shed_rate"])
+    tm = twin_summary["latency_ms"]["mean"]
+    rm = real_summary["latency_ms"]["mean"]
+    if tm is None or rm is None or rm <= 0:
+        return shed_gap
+    return max(shed_gap, abs(tm - rm) / rm)
+
+
+# ------------------------------------------------------------------ run
+def _records(scn: Scenario, smoke: bool, seed: Optional[int]):
+    params = dict(scn.params)
+    if smoke:
+        params.update(scn.smoke_params)
+    return generate(
+        scn.generator, scn.seed if seed is None else seed, **params
+    ), params
+
+
+def _twin_faults(scn: Scenario, seed: int, duration_s: float,
+                 replicas: int) -> list[dict]:
+    if scn.chaos != "replica_kill":
+        return []
+    plan = FaultPlan.replica_kill_midsoak(
+        seed, window=max(2, int(duration_s / _CHAOS_TICK_S)),
+        replicas=replicas,
+    )
+    return [{
+        "kind": "replica_down",
+        "replica": plan.params["kill_slot"],
+        "at_s": plan.params["kill_tick"] * _CHAOS_TICK_S,
+        # the monitor's restart latency, scaled into sim time
+        "duration_s": 1.0,
+    }]
+
+
+def run_twin(scn: Scenario, *, smoke: bool = False,
+             seed: Optional[int] = None,
+             costs: Optional[PhaseCosts] = None) -> dict:
+    records, params = _records(scn, smoke, seed)
+    use_seed = scn.seed if seed is None else seed
+    cfg = TwinConfig(**{
+        "replicas": 2, "max_batch": 4, "max_queue": 64,
+        "kv_pool_pages": 96, "kv_page_tokens": 8,
+        **scn.twin_config,
+    })
+    horizon = float(params.get("duration_s") or 0.0)
+    if not horizon:
+        n, rps = params.get("n", 0), params.get("rps", 0)
+        horizon = (n / rps) if rps else 0.0
+    twin = ServingTwin(
+        cfg, costs or PhaseCosts(),
+        faults=_twin_faults(scn, use_seed, horizon, cfg.replicas),
+        seed=use_seed,
+    )
+    summary = twin.run(records)
+    metrics = {"kv_pages_leaked": summary["kv_pages_leaked"]}
+    verdicts = evaluate(scn.assertions, summary, metrics)
+    return {
+        "scenario": scn.name,
+        "mode": "twin",
+        "seed": use_seed,
+        "params": params,
+        "summary": summary,
+        "assertions": verdicts,
+        "pass": all(v["ok"] for v in verdicts),
+    }
+
+
+def run_real(scn: Scenario, *, smoke: bool = False,
+             seed: Optional[int] = None, rig: Optional[Rig] = None,
+             replicas: int = 2, time_scale: Optional[float] = None) -> dict:
+    if scn.twin_only:
+        raise ValueError(f"scenario {scn.name} is twin-only")
+    records, params = _records(scn, smoke, seed)
+    records = list(records)
+    use_seed = scn.seed if seed is None else seed
+    own_rig = rig is None
+    if own_rig:
+        rig = build_rig(replicas=replicas, overrides=scn.serving_overrides)
+    stop_chaos = threading.Event()
+    chaos_thread = None
+    chaos_params = {}
+    try:
+        if scn.chaos == "replica_kill":
+            horizon = float(params.get("duration_s", 10.0))
+            plan = FaultPlan.replica_kill_midsoak(
+                use_seed,
+                window=max(2, int(horizon / _CHAOS_TICK_S)),
+                replicas=rig.replicas,
+            )
+            chaos_params = dict(plan.params)
+            slot = plan.params["kill_slot"]
+
+            def _tick():
+                while not stop_chaos.wait(_CHAOS_TICK_S):
+                    fault = plan.fire("scenario.replica_kill")
+                    if fault is not None and fault.action == "kill":
+                        try:
+                            rig.mgr.replica(slot).kill()
+                        except Exception:  # noqa: BLE001 — already dead is fine
+                            pass
+
+            chaos_thread = threading.Thread(target=_tick, daemon=True)
+            chaos_thread.start()
+        report = replay(
+            records, rig.url,
+            vocab_size=RIG_MODEL_CFG["vocab_size"],
+            time_scale=time_scale or scn.time_scale,
+            timeout_s=60.0,
+            rid_prefix=scn.name,
+        )
+        stop_chaos.set()
+        texts = _wait_drained(rig)
+        summary = report.summary()
+        live_texts = [t for t in texts if t]
+        metrics = {
+            # every live replica permanently holds exactly one page (the
+            # KV manager's scratch page, allocated at construction and
+            # backing dummy rows) — anything above that at drain is a leak
+            "kv_pages_leaked": int(sum(
+                max(0.0,
+                    parse_prometheus_text(t).value("serving_kv_pages_used",
+                                                   0.0) - 1.0)
+                for t in live_texts
+            )),
+            "client_disconnects": int(
+                _sum_metric(live_texts, "serving_client_disconnects_total")
+            ),
+            "slo_burn": (
+                max(
+                    (parse_prometheus_text(t).value("slo_burn_rate", 0.0)
+                     for t in live_texts),
+                    default=0.0,
+                )
+                if live_texts else None
+            ),
+        }
+        verdicts = evaluate(scn.assertions, summary, metrics)
+        return {
+            "scenario": scn.name,
+            "mode": "real",
+            "seed": use_seed,
+            "params": params,
+            "chaos": chaos_params or None,
+            "summary": summary,
+            "metrics": metrics,
+            "replica_metricsz": live_texts,
+            "assertions": verdicts,
+            "pass": all(v["ok"] for v in verdicts),
+        }
+    finally:
+        stop_chaos.set()
+        if chaos_thread is not None:
+            chaos_thread.join(2.0)
+        if own_rig:
+            rig.stop()
+
+
+def run_scenario(name: str, *, mode: str = "real", smoke: bool = False,
+                 seed: Optional[int] = None, rig: Optional[Rig] = None,
+                 replicas: int = 2,
+                 costs: Optional[PhaseCosts] = None) -> dict:
+    try:
+        scn = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r} "
+            f"(have: {', '.join(sorted(SCENARIOS))})"
+        ) from None
+    if mode == "twin" or (scn.twin_only and mode != "real"):
+        return run_twin(scn, smoke=smoke, seed=seed, costs=costs)
+    if mode != "real":
+        raise ValueError(f"mode must be real|twin, got {mode!r}")
+    return run_real(scn, smoke=smoke, seed=seed, rig=rig, replicas=replicas)
+
+
+def scenario_table() -> list[dict]:
+    """`polyaxon scenario ls` rows."""
+    return [
+        {
+            "name": s.name,
+            "generator": s.generator,
+            "chaos": s.chaos or "-",
+            "mode": "twin-only" if s.twin_only else "real+twin",
+            "description": s.description,
+        }
+        for s in SCENARIOS.values()
+    ]

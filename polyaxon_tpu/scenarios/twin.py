@@ -1,0 +1,390 @@
+"""Discrete-event serving twin: million-user soaks in seconds on CI.
+
+The twin replays a trace through a simulated router + replica set on
+the injectable `scheduler.clock.SimClock` — the same sim-validates-real
+idiom `scheduler/sim.py` proved for the fleet scheduler, pointed at the
+serving stack. Simulated replicas are driven by measured per-phase
+costs (`PhaseCosts`: prefill-per-token, decode-step, per-batch
+overhead) fitted from real `/metricsz` scrapes, so a multi-hour
+million-request soak runs in seconds of wall time while the real stack
+validates the twin's shed-rate and latency predictions at small scale
+(`benchmarks/scenario_bench.py` pins `sim_vs_real_calibration_error`).
+
+What the twin models — deliberately at batch granularity, the level the
+measured costs live at:
+
+* JSQ routing with shed-retry on a sibling (the router's 503 retry);
+* bounded per-replica queues (`max_queue`) shedding `queue_full`;
+* KV page reservation at admission (`kv_pool_pages`) shedding
+  `kv_pages` when the pool cannot fit the row — the exhaustion
+  ingredient;
+* batched service: up to `max_batch` rows prefill together and decode
+  in lockstep for max-of-row steps (the coalescer's group shape);
+* deadline purge at dispatch (504s without spending step budget);
+* mid-stream client disconnects truncating a row's decode steps (the
+  satellite-1 cancellation path);
+* chaos ingredients: replica-down windows (queued + in-flight rows
+  fail over to siblings; the dead replica's pages drop with it, the
+  monitor brings it back empty).
+
+Invariants checked structurally at drain: every offered request has
+exactly one outcome (zero hung) and every page is back in the pool
+(zero leaked). No raw clocks anywhere (lint_telemetry rule 13) — the
+wall-clock timing of a twin run is the CALLER's business.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import math
+import random
+from collections import deque
+from typing import Iterable, Optional
+
+from ..scheduler.clock import SimClock
+from ..telemetry import parse_prometheus_text, quantile
+from .traces import TraceRequest
+
+_RESERVOIR = 200_000  # latency samples kept for quantiles (seeded reservoir)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseCosts:
+    """Measured per-phase serving costs, milliseconds."""
+
+    prefill_ms_per_token: float = 0.08
+    decode_step_ms: float = 2.0
+    batch_overhead_ms: float = 4.0
+
+    @classmethod
+    def fit(cls, metricsz_texts, mean_prompt_tokens: float,
+            mean_new_tokens: float, baseline_texts=None) -> "PhaseCosts":
+        """Fit costs from real `/metricsz` scrapes (one text per replica;
+        sums and counts aggregate across them) plus the trace's mean
+        shape. TTFT is anchored at admission, so queue wait is
+        subtracted before attributing the remainder to prefill; the
+        decode region is mean latency minus mean TTFT spread over the
+        remaining steps. The 80/20 prefill/overhead split is a
+        convention — at calibration scale the two are not separable
+        from means alone, and the twin only ever uses their sum plus
+        the per-token slope.
+
+        `baseline_texts` (scrapes taken BEFORE the measured run, same
+        replica order) are subtracted so warmup traffic — above all the
+        XLA compiles it pays for — does not pollute the steady-state
+        costs."""
+        if isinstance(metricsz_texts, str):
+            metricsz_texts = [metricsz_texts]
+        if isinstance(baseline_texts, str):
+            baseline_texts = [baseline_texts]
+        tt_sum = tt_n = lat_sum = lat_n = qw_sum = qw_n = 0.0
+        for text in metricsz_texts:
+            snap = parse_prometheus_text(text)
+            tt_sum += snap.value("serving_ttft_ms_sum")
+            tt_n += snap.value("serving_ttft_ms_count")
+            lat_sum += snap.value("serving_request_seconds_sum")
+            lat_n += snap.value("serving_request_seconds_count")
+            qw_sum += snap.value("serving_queue_wait_seconds_sum")
+            qw_n += snap.value("serving_queue_wait_seconds_count")
+        for text in baseline_texts or ():
+            snap = parse_prometheus_text(text)
+            tt_sum -= snap.value("serving_ttft_ms_sum")
+            tt_n -= snap.value("serving_ttft_ms_count")
+            lat_sum -= snap.value("serving_request_seconds_sum")
+            lat_n -= snap.value("serving_request_seconds_count")
+            qw_sum -= snap.value("serving_queue_wait_seconds_sum")
+            qw_n -= snap.value("serving_queue_wait_seconds_count")
+        if not tt_n or not lat_n:
+            raise ValueError(
+                "cannot fit PhaseCosts: no serving_ttft_ms/"
+                "serving_request_seconds samples in the scrapes"
+            )
+        ttft_ms = tt_sum / tt_n
+        lat_ms = (lat_sum / lat_n) * 1e3
+        qw_ms = (qw_sum / qw_n) * 1e3 if qw_n else 0.0
+        prefill_ms = max(0.05, ttft_ms - qw_ms)
+        decode_ms = max(0.0, lat_ms - ttft_ms)
+        steps = max(1.0, mean_new_tokens - 1.0)
+        return cls(
+            prefill_ms_per_token=0.8 * prefill_ms / max(1.0, mean_prompt_tokens),
+            decode_step_ms=max(0.01, decode_ms / steps),
+            batch_overhead_ms=0.2 * prefill_ms,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TwinConfig:
+    """The slice of ServingConfig the twin models."""
+
+    replicas: int = 2
+    max_batch: int = 4
+    max_queue: int = 64
+    kv_pool_pages: Optional[int] = None
+    kv_page_tokens: int = 8
+    retry_on_shed: bool = True  # the router's sibling retry
+
+
+class _Row:
+    __slots__ = ("i", "arrive_t", "prompt_len", "max_new", "deadline",
+                 "disconnect_after_ms", "pages", "attempts")
+
+    def __init__(self, rec: TraceRequest, arrive_t: float, pages: int):
+        self.i = rec.i
+        self.arrive_t = arrive_t
+        self.prompt_len = rec.prompt_len
+        self.max_new = rec.max_new
+        self.deadline = (
+            arrive_t + rec.deadline_ms / 1e3
+            if rec.deadline_ms is not None else None
+        )
+        self.disconnect_after_ms = rec.disconnect_after_ms
+        self.pages = pages
+        self.attempts = 0
+
+
+class _Replica:
+    __slots__ = ("up", "queue", "batch", "pages_used")
+
+    def __init__(self):
+        self.up = True
+        self.queue: deque[_Row] = deque()
+        self.batch: Optional[list[_Row]] = None
+        self.pages_used = 0
+
+    def depth(self) -> int:
+        return len(self.queue) + (len(self.batch) if self.batch else 0)
+
+
+class ServingTwin:
+    """One twin run: `run(records)` consumes a (lazy) record stream and
+    returns the aggregate report. Faults are dicts —
+    `{"kind": "replica_down", "replica": r, "at_s": t, "duration_s": d}`
+    — usually derived from the same seed as the scenario's real-stack
+    FaultPlan so twin and rig replay the same story."""
+
+    def __init__(self, cfg: TwinConfig, costs: PhaseCosts, *,
+                 faults: Iterable[dict] = (), seed: int = 0):
+        self.cfg = cfg
+        self.costs = costs
+        self.clock = SimClock()
+        self.replicas = [_Replica() for _ in range(cfg.replicas)]
+        self._events: list[tuple[float, int, str, object]] = []
+        self._seq = 0
+        for f in faults:
+            if f.get("kind") != "replica_down":
+                raise ValueError(f"unknown twin fault kind: {f!r}")
+            r = int(f["replica"]) % cfg.replicas
+            t = float(f["at_s"])
+            self._push(t, "down", r)
+            self._push(t + float(f.get("duration_s", 1.0)), "up", r)
+        # outcome ledger (aggregates + seeded latency reservoirs)
+        self.counts = {
+            "ok": 0, "shed": 0, "deadline_504": 0, "disconnected": 0,
+            "error": 0,
+        }
+        self.shed_reasons: dict[str, int] = {}
+        self._lat_res: list[float] = []
+        self._ttft_res: list[float] = []
+        self._lat_sum = 0.0
+        self._lat_n = 0
+        self._rng = random.Random(f"twin-reservoir:{seed}")
+        self.offered = 0
+        self.resolved = 0
+
+    # ------------------------------------------------------------ events
+    def _push(self, t: float, kind: str, data) -> None:
+        self._seq += 1
+        heapq.heappush(self._events, (t, self._seq, kind, data))
+
+    # ---------------------------------------------------------- routing
+    def _admit(self, rec: TraceRequest, now: float) -> None:
+        self.offered += 1
+        pages = 0
+        if self.cfg.kv_pool_pages:
+            pages = -(-(rec.prompt_len + rec.max_new) // self.cfg.kv_page_tokens)
+        row = _Row(rec, now, pages)
+        order = sorted(
+            (i for i, r in enumerate(self.replicas) if r.up),
+            key=lambda i: self.replicas[i].depth(),
+        )
+        if not self.cfg.retry_on_shed:
+            order = order[:1]
+        reason = "unavailable"
+        for i in order:
+            rep = self.replicas[i]
+            if rep.depth() >= self.cfg.max_queue:
+                reason = "queue_full"
+                continue
+            if (
+                self.cfg.kv_pool_pages
+                and rep.pages_used + pages > self.cfg.kv_pool_pages
+            ):
+                reason = "kv_pages"
+                continue
+            rep.pages_used += pages
+            rep.queue.append(row)
+            self._maybe_start(i, now)
+            return
+        self.counts["shed"] += 1
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        self.resolved += 1
+
+    def _requeue(self, row: _Row, now: float) -> None:
+        """Failover: a dying replica's row retries on a sibling, keeping
+        its original arrival time (the client pays for the redo)."""
+        row.attempts += 1
+        order = sorted(
+            (i for i, r in enumerate(self.replicas) if r.up),
+            key=lambda i: self.replicas[i].depth(),
+        )
+        for i in order:
+            rep = self.replicas[i]
+            if rep.depth() >= self.cfg.max_queue:
+                continue
+            if (
+                self.cfg.kv_pool_pages
+                and rep.pages_used + row.pages > self.cfg.kv_pool_pages
+            ):
+                continue
+            rep.pages_used += row.pages
+            rep.queue.append(row)
+            self._maybe_start(i, now)
+            return
+        self.counts["error"] += 1
+        self.resolved += 1
+
+    # ---------------------------------------------------------- service
+    def _maybe_start(self, i: int, now: float) -> None:
+        rep = self.replicas[i]
+        if not rep.up or rep.batch is not None or not rep.queue:
+            return
+        c = self.costs
+        # deadline purge at dispatch: 504 without spending step budget
+        while rep.queue:
+            head = rep.queue[0]
+            if head.deadline is not None and head.deadline <= now:
+                rep.queue.popleft()
+                rep.pages_used -= head.pages
+                self.counts["deadline_504"] += 1
+                self.resolved += 1
+                continue
+            break
+        if not rep.queue:
+            return
+        batch = [
+            rep.queue.popleft()
+            for _ in range(min(self.cfg.max_batch, len(rep.queue)))
+        ]
+        steps = 0
+        for row in batch:
+            eff = row.max_new
+            if row.disconnect_after_ms is not None:
+                # a disconnected client's row is cancelled promptly
+                # (satellite 1): it decodes only until the disconnect
+                eff = min(
+                    eff,
+                    1 + math.ceil(row.disconnect_after_ms / c.decode_step_ms),
+                )
+            steps = max(steps, eff - 1)
+        prefill_ms = (
+            c.batch_overhead_ms
+            + c.prefill_ms_per_token * max(r.prompt_len for r in batch)
+        )
+        service_s = (prefill_ms + steps * c.decode_step_ms) / 1e3
+        rep.batch = batch
+        self._push(now + service_s, "finish", (i, now + prefill_ms / 1e3))
+
+    def _finish(self, i: int, first_token_t: float, now: float) -> None:
+        rep = self.replicas[i]
+        batch, rep.batch = rep.batch, None
+        for row in batch or ():
+            rep.pages_used -= row.pages
+            ttft_ms = (first_token_t - row.arrive_t) * 1e3
+            if row.disconnect_after_ms is not None:
+                end = first_token_t + row.disconnect_after_ms / 1e3
+                self.counts["disconnected"] += 1
+                self._observe(min(end, now) - row.arrive_t, ttft_ms)
+            else:
+                self.counts["ok"] += 1
+                self._observe(now - row.arrive_t, ttft_ms)
+            self.resolved += 1
+        self._maybe_start(i, now)
+
+    def _observe(self, latency_s: float, ttft_ms: float) -> None:
+        lat_ms = latency_s * 1e3
+        self._lat_sum += lat_ms
+        self._lat_n += 1
+        for res, v in ((self._lat_res, lat_ms), (self._ttft_res, ttft_ms)):
+            if len(res) < _RESERVOIR:
+                res.append(v)
+            else:
+                j = self._rng.randrange(self._lat_n)
+                if j < _RESERVOIR:
+                    res[j] = v
+
+    # ------------------------------------------------------------- chaos
+    def _down(self, i: int, now: float) -> None:
+        rep = self.replicas[i]
+        rep.up = False
+        # the process died: its pages die with it, its rows fail over
+        orphans = list(rep.batch or []) + list(rep.queue)
+        rep.batch = None
+        rep.queue.clear()
+        rep.pages_used = 0
+        for row in orphans:
+            self._requeue(row, now)
+
+    def _up(self, i: int, now: float) -> None:
+        # the monitor restarted it: empty queue, empty pool
+        self.replicas[i].up = True
+        self._maybe_start(i, now)
+
+    # -------------------------------------------------------------- run
+    def run(self, records: Iterable[TraceRequest]) -> dict:
+        arrivals = iter(records)
+        nxt = next(arrivals, None)
+        while nxt is not None or self._events:
+            if nxt is not None and (
+                not self._events or nxt.at <= self._events[0][0]
+            ):
+                now = self.clock.advance_to(max(self.clock.time(), nxt.at))
+                self._admit(nxt, now)
+                nxt = next(arrivals, None)
+                continue
+            t, _, kind, data = heapq.heappop(self._events)
+            now = self.clock.advance_to(max(self.clock.time(), t))
+            if kind == "finish":
+                i, first_t = data
+                self._finish(i, first_t, now)
+            elif kind == "down":
+                self._down(data, now)
+            elif kind == "up":
+                self._up(data, now)
+        return self.report()
+
+    def report(self) -> dict:
+        hung = self.offered - self.resolved
+        leaked = sum(r.pages_used for r in self.replicas)
+        lat = sorted(self._lat_res)
+        ttft = sorted(self._ttft_res)
+        shed = self.counts["shed"]
+        return {
+            "mode": "twin",
+            "offered": self.offered,
+            **self.counts,
+            "shed_reasons": dict(self.shed_reasons),
+            "shed_rate": round(shed / self.offered, 4) if self.offered else 0.0,
+            "hung": hung,
+            "kv_pages_leaked": leaked,
+            "latency_ms": {
+                "p50": quantile(lat, 0.5),
+                "p99": quantile(lat, 0.99),
+                "mean": (self._lat_sum / self._lat_n) if self._lat_n else None,
+            },
+            "ttft_ms": {
+                "p50": quantile(ttft, 0.5),
+                "p99": quantile(ttft, 0.99),
+            },
+            "sim_duration_s": round(self.clock.time(), 3),
+        }

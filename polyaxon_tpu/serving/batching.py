@@ -98,6 +98,13 @@ class DeadlineExceededError(ServingError):
     decode slot was spent on it (goodput, not throughput). HTTP 504."""
 
 
+class ClientDisconnectedError(ServingError):
+    """The streaming client went away mid-request (broken pipe). Nobody
+    is listening for the result: the row is cancelled, its KV pages and
+    decode slot released promptly. Never surfaces over HTTP — there is
+    no client left to see it."""
+
+
 class WorkerCrashError(RuntimeError):
     """The decode worker died with this group in flight; the watchdog
     failed the group fast and restarted the worker. NOT a ServingError:
@@ -322,6 +329,16 @@ class PendingRequest:
     row: int = 0
     submitted_t: Optional[float] = None
     finished_t: Optional[float] = None
+    # mid-stream client disconnect (ISSUE 16 satellite): the HTTP layer
+    # flips this when the socket breaks; the coalescer/scheduler notice
+    # at their next sweep and release the row's resources promptly
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        """Mark the row as abandoned by its client. Safe from any thread;
+        a no-op once the row already resolved."""
+        if not self.done.is_set():
+            self.cancelled = True
 
     def finish(self, result=None, error=None):
         # idempotent: losing racers (deadline sweep vs decode completion)
@@ -495,6 +512,7 @@ class DecodeCoalescer:
         self.rows_run = 0
         self.shed_total = 0
         self.deadline_dropped = 0
+        self.cancel_dropped = 0
         self.worker_restarts = 0
 
     # ----------------------------------------------------------- observers
@@ -644,12 +662,24 @@ class DecodeCoalescer:
         ))
         self._resolve()
 
+    def _drop_cancelled(self, req: PendingRequest) -> None:
+        self.cancel_dropped += 1
+        self._observe("client_cancelled")
+        req.finish(error=ClientDisconnectedError(
+            "client disconnected before decode dispatch"
+        ))
+        self._resolve()
+
     def _purge_expired(self) -> None:
         """Drop every pending request whose deadline has passed — BEFORE a
-        decode slot is spent on it (goodput over throughput)."""
+        decode slot is spent on it (goodput over throughput). Cancelled
+        rows (client gone) go the same way: nobody wants their tokens."""
         if not self._pending:
             return
         now = time.monotonic()
+        for r in [r for r in self._pending if r.cancelled]:
+            self._pending.remove(r)
+            self._drop_cancelled(r)
         dead = [r for r in self._pending if r.expired(now)]
         for r in dead:
             self._pending.remove(r)
@@ -729,7 +759,9 @@ class DecodeCoalescer:
             now = time.monotonic()
             live = []
             for r in batch:
-                if r.expired(now):
+                if r.cancelled:
+                    self._drop_cancelled(r)
+                elif r.expired(now):
                     self._drop_expired(r)
                 else:
                     live.append(r)

@@ -451,10 +451,21 @@ class ModelServer:
             "serving.http_errors",
             help="HTTP /generate 5xx-class failures (500/503/504)",
         )
+        # mid-stream client disconnects (ISSUE 16): streamed requests whose
+        # socket broke before the stream finished — their rows are
+        # cancelled and their KV pages released promptly
+        self._m_client_disconnects = self.telemetry.counter(
+            "serving.client_disconnects",
+            help="Streamed /generate requests whose client vanished "
+            "mid-stream (broken pipe); rows cancelled, pages released",
+        )
         self.traces = TraceRing(capacity=int(self.config.trace_ring))
         import itertools
 
         self._group_seq = itertools.count(1)
+        # live streamed requests by request id, so a broken pipe in the
+        # HTTP layer can cancel the right rows (ISSUE 16 satellite)
+        self._stream_rows: dict = {}
         # SLO engine + flight recorder (ISSUE 9): objectives come from
         # observability.slos in the run spec (from_run) or the `slos`
         # ctor arg (dicts shaped like V1SLOSpec.to_config()); a breach
@@ -1745,6 +1756,11 @@ class ModelServer:
                 # would all be pinned to eos_id — emit them host-side
                 early_eos = True
                 break
+            if all(r.cancelled for r in batch):
+                # every client vanished mid-stream (ISSUE 16): stop
+                # decoding rows nobody will read — finish() below still
+                # releases their pages through on_finish
+                break
         if early_eos:
             for i, r in enumerate(batch):
                 short = r.max_new - len(gen[i])
@@ -2029,6 +2045,8 @@ class ModelServer:
             yield {"done": True}
             return
         rows = self._make_requests(req)
+        if rid is not None:
+            self._stream_rows[rid] = rows
         events: _queue.Queue = _queue.Queue()
         for i, r in enumerate(rows):
             r.on_tokens = (
@@ -2046,41 +2064,65 @@ class ModelServer:
                 )
 
             r.on_finish = _finished
-        submitted = []
         try:
-            for r in rows:
-                r.submitted_t = _now()
-                self._coalescer.submit(r)
-                submitted.append(r)
-        except ShedError:
-            for r in rows:
-                if r not in submitted and r.kv_plan is not None:
-                    self._kv.release(r.kv_plan)
-            for r in submitted:
-                r.done.wait(self.config.request_timeout_s)
-            raise
-        if trace is not None:
-            first = rows[0].submitted_t if rows else trace.t0
-            trace.add("admission", start=trace.t0, dur_s=first - trace.t0)
-        pending = len(rows)
-        while pending:
+            submitted = []
             try:
-                ev = events.get(timeout=self.config.request_timeout_s)
-            except _queue.Empty:
-                raise TimeoutError(
-                    f"decode did not complete within "
-                    f"{self.config.request_timeout_s:.0f}s"
-                ) from None
-            if "done" in ev or "error" in ev:
-                pending -= 1
-            yield ev
-        if trace is not None:
-            done_t = max(
-                (r.finished_t for r in rows if r.finished_t is not None),
-                default=_now(),
-            )
-            trace.add("stream_flush", start=done_t, dur_s=_now() - done_t)
-        yield {"done": True}
+                for r in rows:
+                    r.submitted_t = _now()
+                    self._coalescer.submit(r)
+                    submitted.append(r)
+            except ShedError:
+                for r in rows:
+                    if r not in submitted and r.kv_plan is not None:
+                        self._kv.release(r.kv_plan)
+                for r in submitted:
+                    r.done.wait(self.config.request_timeout_s)
+                raise
+            if trace is not None:
+                first = rows[0].submitted_t if rows else trace.t0
+                trace.add("admission", start=trace.t0, dur_s=first - trace.t0)
+            pending = len(rows)
+            while pending:
+                try:
+                    ev = events.get(timeout=self.config.request_timeout_s)
+                except _queue.Empty:
+                    raise TimeoutError(
+                        f"decode did not complete within "
+                        f"{self.config.request_timeout_s:.0f}s"
+                    ) from None
+                if "done" in ev or "error" in ev:
+                    pending -= 1
+                yield ev
+            if trace is not None:
+                done_t = max(
+                    (r.finished_t for r in rows if r.finished_t is not None),
+                    default=_now(),
+                )
+                trace.add("stream_flush", start=done_t, dur_s=_now() - done_t)
+            yield {"done": True}
+        finally:
+            if rid is not None:
+                self._stream_rows.pop(rid, None)
+
+    def cancel_stream(self, rid: str) -> int:
+        """Cancel a live streamed request's unfinished rows — called by
+        the HTTP layer on a broken pipe. The coalescer/step scheduler
+        notice the flag at their next sweep, evict the rows, and
+        `on_finish` releases their KV pages. Returns the number of rows
+        cancelled; increments `serving_client_disconnects_total` once
+        per request that still had live rows."""
+        rows = self._stream_rows.get(rid)
+        if not rows:
+            return 0
+        n = 0
+        for r in rows:
+            if not r.done.is_set():
+                r.cancel()
+                n += 1
+        if n:
+            self._m_client_disconnects.inc()
+            self._observe("client_disconnect", request_id=rid, rows=n)
+        return n
 
     # --------------------------------------------------------- readiness
     def readiness(self) -> tuple[bool, str]:
@@ -2378,10 +2420,12 @@ class ModelServer:
                             b"data: " + json.dumps(ev).encode() + b"\n\n"
                         )
                         self.wfile.flush()
-                except BrokenPipeError:
-                    # client went away mid-stream; decode finishes on its
-                    # own and the rows release their pages via on_finish
-                    pass
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away mid-stream (ISSUE 16): cancel the
+                    # request's rows so the scheduler evicts them at its
+                    # next sweep and their KV pages come back promptly,
+                    # instead of decoding to completion for nobody
+                    server.cancel_stream(rid)
                 except Exception as e:  # noqa: BLE001 — in-band, then close
                     try:
                         self.wfile.write(

@@ -46,6 +46,7 @@ from typing import Callable, Optional
 from ..chaos.injector import inject
 from .batching import (
     CircuitBreaker,
+    ClientDisconnectedError,
     DeadlineExceededError,
     DecodeCoalescer,
     PendingRequest,
@@ -187,8 +188,20 @@ class StepScheduler(DecodeCoalescer):
     def _evict_expired_active(self) -> None:
         """PR 5 semantics mid-flight: a row whose deadline passed is
         evicted between steps — it 504s without spending step tokens, and
-        `on_finish` releases its (possibly partial) KV pages."""
+        `on_finish` releases its (possibly partial) KV pages. Cancelled
+        rows (client disconnected, ISSUE 16) leave the same way, freeing
+        their decode slot and pages for clients still listening."""
         for pool in (self._prefilling, self._decoding, self._classic):
+            gone = [r for r in pool if r.cancelled]
+            for r in gone:
+                pool.remove(r)
+                self.evicted_midflight += 1
+                self.cancel_dropped += 1
+                self._observe("client_cancelled")
+                r.finish(error=ClientDisconnectedError(
+                    "client disconnected mid-flight: evicted between steps"
+                ))
+                self._resolve()
             dead = [r for r in pool if r.expired()]
             for r in dead:
                 pool.remove(r)

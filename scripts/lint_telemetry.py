@@ -131,6 +131,20 @@ byte-identity replays the tests pin would stop being replays. Any
 `datetime.now/utcnow/today` call in those two files is forbidden: count
 proposals and logical steps, never seconds.
 
+Thirteenth rule: NO raw clock in the scenario engine. Everything under
+`polyaxon_tpu/scenarios/` — trace generation, the open-loop replay
+driver, the discrete-event twin, the scenario registry — must take
+measurements from `telemetry.now()` and schedule waits through
+`threading.Event.wait`. The whole point of the engine is replayability:
+a trace is a pure function of (generator, seed, params), the twin runs
+on the injectable SimClock, and the driver's ledger is what the
+calibration gate (`sim_vs_real_calibration_error`) diffs against the
+twin. A raw `time.*()` / `datetime.now()` / `time.sleep` read anywhere
+in there would couple a scenario's story to the host clock — the same
+seed would stop replaying the same soak. Any direct `time.time/
+monotonic/perf_counter/sleep` (and `_ns` variants) or
+`datetime.now/utcnow/today` call in that directory is forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -217,6 +231,13 @@ ADAPTIVE_MODULES = (
     ("polyaxon_tpu", "models", "draft.py"),
     ("polyaxon_tpu", "serving", "adaptive.py"),
 )
+SCENARIO_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: the scenario engine replays: traces are pure functions of their seed,
+#: the twin rides SimClock, the driver measures on telemetry.now() and
+#: waits on threading.Event (rule 13)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -262,6 +283,7 @@ def violations(repo_root: Path) -> list[str]:
         in_pure = rel.parts in PURE_MODULES
         in_steps = rel.parts in STEPS_MODULES
         in_adaptive = rel.parts in ADAPTIVE_MODULES
+        in_scenarios = rel.parts[:2] == ("polyaxon_tpu", "scenarios")
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -326,6 +348,13 @@ def violations(repo_root: Path) -> list[str]:
                     f"{rel}:{i}: raw clock in adaptive speculation — "
                     f"drafting and K control count proposals and "
                     f"logical steps, never seconds: {line.strip()}"
+                )
+            if in_scenarios and SCENARIO_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in the scenario engine — "
+                    f"traces replay from their seed, the twin rides "
+                    f"SimClock; measure via telemetry.now(), wait via "
+                    f"threading.Event.wait: {line.strip()}"
                 )
     return out
 

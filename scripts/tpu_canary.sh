@@ -194,6 +194,41 @@ PY
       fi
     done
     echo "overload smoke: ok $(date -u +%T)" >> "$log"
+    # scenario gate (ISSUE 16): the scenario engine end to end. First
+    # benchmarks/scenario_bench.py --smoke — every named scenario
+    # through the twin, the million-request soak under its 60s wall
+    # pin, and the twin-vs-real calibration against a live 2-replica
+    # rig (sim_vs_real_calibration_error <= 0.25, exit 1 past it).
+    # Then the disconnect_storm scenario for real via the CLI so the
+    # mid-stream-cancellation path actually fires on this hardware,
+    # and require the resilience series (above all
+    # serving_client_disconnects_total) in the rig's /metricsz text.
+    # A twin that drifts from the stack it predicts — or a server that
+    # cannot account for vanished clients — FAILS the canary.
+    echo "running scenario smoke $(date -u +%T)" >> "$log"
+    if ! timeout 900 python benchmarks/scenario_bench.py --smoke \
+        --metricsz-out tpu_results/scenario_metricsz_tpu.txt \
+        > tpu_results/scenario_tpu.json 2>> "$log"; then
+      echo "SCENARIO-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      cat tpu_results/scenario_tpu.json >> "$log" 2>/dev/null
+      exit 1
+    fi
+    cat tpu_results/scenario_tpu.json >> "$log"
+    if ! timeout 600 python -m polyaxon_tpu.cli.main scenario run \
+        disconnect_storm --smoke \
+        --out tpu_results/scenario_disconnect_tpu.json >> "$log" 2>&1; then
+      echo "SCENARIO-SMOKE-FAILED: disconnect_storm $(date -u +%T)" >> "$log"
+      cat tpu_results/scenario_disconnect_tpu.json >> "$log" 2>/dev/null
+      exit 1
+    fi
+    for series in serving_client_disconnects_total serving_shed_total \
+        serving_kv_pages_used serving_queue_depth; do
+      if ! grep -q "$series" tpu_results/scenario_metricsz_tpu.txt; then
+        echo "SCENARIO-SMOKE-FAILED: missing series $series $(date -u +%T)" >> "$log"
+        exit 1
+      fi
+    done
+    echo "scenario smoke: ok $(date -u +%T)" >> "$log"
     # paged-KV gate: drive warm traffic (same prompt twice -> prefix
     # reuse) plus a streamed request through a pool-backed server and
     # require the KV/TTFT series on /metricsz. A paged deployment whose
