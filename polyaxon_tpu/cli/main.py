@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import sys
+from pathlib import Path
 
 import click
 
@@ -824,6 +825,21 @@ def agent_drain(queues):
 @click.option("--max-step-tokens", default=None, type=int,
               help="token budget one device step may touch: all decode "
                    "rows plus at most one prefill slice (default 256)")
+@click.option("--spill-ram-bytes", default=None, type=int,
+              help="host-RAM budget for evicted prefix-cache entries: a "
+                   "later hit restores the pages instead of re-prefilling "
+                   "(requires --kv-pool-pages with the prefix cache)")
+@click.option("--spill-dir", default=None, type=str,
+              help="directory for the on-disk spill tier below the RAM "
+                   "tier (CRC-framed segments; torn tails truncated, "
+                   "corrupt segments quarantined at startup)")
+@click.option("--spill-dir-bytes", default=None, type=int,
+              help="byte budget for the on-disk spill tier (oldest "
+                   "segments dropped first; requires --spill-dir)")
+@click.option("--no-affinity", is_flag=True,
+              help="router mode: disable prefix-affinity routing (warm "
+                   "prompts no longer stick to the replica holding their "
+                   "prefix KV)")
 @click.option("--no-trace", is_flag=True,
               help="disable per-request tracing (/tracez and X-Request-Id "
                    "correlation stay, but no span timelines are recorded)")
@@ -847,6 +863,7 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           no_stream, speculate, draft_tokens, quantize, draft_model,
           adaptive_draft, kv_quant, chunked_prefill,
           no_chunked_prefill, prefill_chunk_tokens, max_step_tokens,
+          spill_ram_bytes, spill_dir, spill_dir_bytes, no_affinity,
           no_trace, replicas, mesh_model, route, autoscale_max):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
@@ -934,6 +951,9 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         ("draft_tokens", draft_tokens),
         ("prefill_chunk_tokens", prefill_chunk_tokens),
         ("max_step_tokens", max_step_tokens),
+        ("spill_ram_bytes", spill_ram_bytes),
+        ("spill_dir", spill_dir),
+        ("spill_dir_bytes", spill_dir_bytes),
     ):
         if value is not None:
             overrides[field] = value
@@ -945,6 +965,7 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
             overrides=overrides,
             expected_devices=expected_devices,
             autoscale_max=autoscale_max,
+            no_affinity=no_affinity,
         )
         return
     try:
@@ -1001,6 +1022,8 @@ _SERVE_FLAG_SPELLING = {
     "kv_quant": "--kv-quant",
     "prefill_chunk_tokens": "--prefill-chunk-tokens",
     "max_step_tokens": "--max-step-tokens",
+    "spill_ram_bytes": "--spill-ram-bytes",
+    "spill_dir_bytes": "--spill-dir-bytes",
 }
 
 
@@ -1034,13 +1057,17 @@ def _serve_child_argv(uid, port, mesh_axes, overrides, expected_devices):
                      ",".join(f"{k}={v}" for k, v in value) or "auto"]
         elif field == "chunked_prefill":
             argv += ["--chunked-prefill" if value else "--no-chunked-prefill"]
+        elif field == "spill_dir" and value:
+            # each replica child gets its own segment namespace: two
+            # processes writing one spill dir would collide on seq names
+            argv += ["--spill-dir", str(Path(value) / f"r{port}")]
         elif field in _SERVE_FLAG_SPELLING:
             argv += [_SERVE_FLAG_SPELLING[field], str(value)]
     return argv
 
 
 def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
-                 expected_devices, autoscale_max):
+                 expected_devices, autoscale_max, no_affinity=False):
     """`polyaxon serve --replicas N --route`: N single-replica children
     as a fleet-placed gang, fronted by the JSQ/P2C router."""
     from ..scheduler.fleet import Fleet
@@ -1098,12 +1125,18 @@ def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
     autoscale = None
     if autoscale_max is not None:
         autoscale = AutoscalePolicy(min_replicas=n, max_replicas=autoscale_max)
+    # prefix affinity: CLI --no-affinity wins, else the run spec's
+    # serving.prefixAffinity, else on (it is a no-op without /kvz heads)
+    affinity = not no_affinity and (
+        serving_spec.prefix_affinity if serving_spec is not None else True
+    )
     router = Router(
         manager.endpoints,
         registry=registry,
         scaler=manager if autoscale is not None else None,
         autoscale=autoscale,
         trace=overrides.get("trace", True),
+        affinity=affinity,
     )
     manager.attach_router(router)
     click.echo(f"starting {n} replica(s)...")

@@ -239,10 +239,15 @@ class PrefixCache:
         *,
         max_pages: Optional[int] = None,
         hash_fn: Optional[HashFn] = None,
+        on_evict: Optional[Callable[[str, PrefixEntry], None]] = None,
     ):
         self.pool = pool
         self.max_pages = max_pages
         self.hash_fn = hash_fn
+        # Demotion hook (ISSUE 17): called with (chain_hash, entry) BEFORE
+        # the entry's page refs drop, so a spill tier can claim the bytes
+        # while the pages are still pinned. Must not re-enter the cache.
+        self.on_evict = on_evict
         self._entries: dict[str, PrefixEntry] = {}
         self._tick = 0
         self.hits = 0
@@ -260,6 +265,19 @@ class PrefixCache:
         """Page references held across entries (shared pages count once
         per entry that names them)."""
         return sum(len(e.pages) for e in self._entries.values())
+
+    @property
+    def held_pages(self) -> int:
+        """DISTINCT pool pages referenced by at least one entry — the
+        pages a warm cache keeps on purpose. Drain accounting subtracts
+        this (plus the scratch page) from pages_used to compute leaks."""
+        return len({p for e in self._entries.values() for p in e.pages})
+
+    def heads(self) -> list[str]:
+        """Chain hashes of every indexed entry — the replica's /kvz
+        advertisement. Every chain link is separately indexed, so this is
+        the full set of prefixes a router-side directory can match on."""
+        return list(self._entries.keys())
 
     def contains(self, tokens) -> bool:
         """True iff the FULL page-aligned content of `tokens` is indexed
@@ -302,6 +320,26 @@ class PrefixCache:
         self.pool.ref(best.pages)
         self.hits += 1
         return best.n_tokens, best.pages, best
+
+    def peek(
+        self, tokens, max_tokens: Optional[int] = None
+    ) -> tuple[int, tuple[int, ...]]:
+        """Longest verified cached prefix WITHOUT refs, active marks, or
+        hit/miss counter churn: (prefix_len, page_ids). A read-only probe
+        for the spill/restore path — the caller holds the KV manager lock,
+        so the result cannot be evicted before it acts on it, and the
+        subsequent real lookup() keeps the hit/miss ledger honest."""
+        limit = len(tokens) if max_tokens is None else min(max_tokens, len(tokens))
+        pt = self.pool.page_tokens
+        best: Optional[PrefixEntry] = None
+        for k, h in enumerate(page_hashes(tokens[:limit], pt, self.hash_fn), 1):
+            e = self._entries.get(h)
+            if e is None or e.tokens != tuple(int(t) for t in tokens[: k * pt]):
+                continue
+            best = e
+        if best is None:
+            return 0, ()
+        return best.n_tokens, best.pages
 
     def release(self, entry: PrefixEntry, pages) -> None:
         """Undo one lookup: drop the request's page refs and active mark."""
@@ -352,6 +390,10 @@ class PrefixCache:
 
     def _evict_one(self, h: str, e: PrefixEntry) -> None:
         del self._entries[h]
+        if self.on_evict is not None:
+            # Pages are still referenced here — the hook may copy/spill
+            # their content before the unref below can recycle them.
+            self.on_evict(h, e)
         self.pool.unref(e.pages)
         self.evictions += 1
 

@@ -54,10 +54,12 @@ class Assertions:
 
     max_shed_rate: float = 1.0
     p99_ms: Optional[float] = None
+    ttft_p50_ms: Optional[float] = None
     max_error_rate: Optional[float] = None
     max_slo_burn: Optional[float] = None
     min_completed: int = 1
     min_disconnects: int = 0
+    min_prefix_hit_rate: Optional[float] = None
     zero_hung: bool = True
     zero_leaked_pages: bool = True
 
@@ -165,6 +167,32 @@ _register(Scenario(
 ))
 
 _register(Scenario(
+    name="prefix_storm",
+    description="Shared-prefix cohorts hammered through the affinity "
+                "router against a starved pool with a RAM spill tier — "
+                "evictions demote to spill, cohort repeats restore, and "
+                "the cluster-wide prefix hit rate is the gate alongside "
+                "warm TTFT.",
+    generator="shared_prefix",
+    params=dict(n=200, rps=8.0, cohorts=4, prompt_len=24, max_new=8),
+    # smoke arrivals spread WELL past the 1-core CI box's ~15s compile
+    # head: prefix lookups happen at admission, so every request that
+    # arrives before the first cohort member harvests is a structural
+    # miss — a bunched trace would measure compile time, not the cache
+    smoke_params=dict(n=24, rps=0.75, cohorts=3, prompt_len=24, max_new=8),
+    serving_overrides=dict(prefix_cache=True, kv_pool_pages=64,
+                           spill_ram_bytes=32 << 20),
+    assertions=Assertions(
+        # ttft_p50 binds in twin mode (the replay posts unstreamed, so
+        # real-mode TTFT is absent and the bound is vacuous there); the
+        # hit-rate gate is what must hold on the real stack
+        max_shed_rate=0.2, max_error_rate=0.0, min_completed=8,
+        min_prefix_hit_rate=0.25, ttft_p50_ms=30_000.0,
+    ),
+    twin_config=dict(prefix_cache=True, kv_pool_pages=64),
+))
+
+_register(Scenario(
     name="million_user_soak",
     description="A million-request, two-hour diurnal soak through the "
                 "discrete-event twin — seconds of wall time on the CI "
@@ -232,9 +260,10 @@ def build_rig(replicas: int = 2, overrides: Optional[dict] = None,
     cfg = ServingConfig(**{
         "max_batch": 4, "max_wait_ms": 2.0, "kv_page_tokens": 8,
         "kv_pool_pages": 96, "stream_chunk_tokens": 4,
-        # prefix_cache off so `serving_kv_pages_used == 0` at drain IS
-        # the zero-leak invariant (a warm prefix cache holds pages on
-        # purpose and would need baseline bookkeeping instead)
+        # prefix_cache off by default so `serving_kv_pages_used == 1` at
+        # drain IS the zero-leak invariant; scenarios that turn it on
+        # (prefix_storm) have their warm pages discounted through the
+        # serving_kv_pages_prefix_held gauge instead
         "prefix_cache": False,
         "request_timeout_s": 60.0,
         **(overrides or {}),
@@ -281,11 +310,20 @@ def _wait_drained(rig: Rig, budget_s: float = 20.0) -> list[str]:
     texts: list[str] = []
     for _ in range(max(1, int(budget_s / 0.2))):
         texts = rig.replica_metricsz()
-        busy = any(
-            parse_prometheus_text(t).value("serving_queue_depth", 0.0) > 0
-            or parse_prometheus_text(t).value("serving_kv_pages_used", 0.0) > 1
-            for t in texts if t
-        )
+        busy = False
+        for t in texts:
+            if not t:
+                continue
+            snap = parse_prometheus_text(t)
+            # pages the prefix cache keeps on purpose are warm state,
+            # not in-flight work — a warm rig still counts as drained
+            held = snap.value("serving_kv_pages_prefix_held", 0.0)
+            if (
+                snap.value("serving_queue_depth", 0.0) > 0
+                or snap.value("serving_kv_pages_used", 0.0) > 1 + held
+            ):
+                busy = True
+                break
         if not busy and any(texts):
             break
         waiter.wait(0.2)
@@ -313,6 +351,17 @@ def evaluate(a: Assertions, summary: dict, metrics: dict) -> list[dict]:
         p99 = summary["latency_ms"]["p99"]
         check("p99_ms", p99 is None or p99 <= a.p99_ms,
               f"p99={p99} <= {a.p99_ms}")
+    if a.ttft_p50_ms is not None:
+        t50 = summary.get("ttft_ms", {}).get("p50")
+        check("ttft_p50_ms", t50 is None or t50 <= a.ttft_p50_ms,
+              f"ttft_p50={t50} <= {a.ttft_p50_ms}")
+    if a.min_prefix_hit_rate is not None:
+        rate = metrics.get("prefix_hit_rate")
+        check(
+            "min_prefix_hit_rate",
+            rate is not None and rate >= a.min_prefix_hit_rate,
+            f"prefix_hit_rate={rate} >= {a.min_prefix_hit_rate}",
+        )
     if a.max_error_rate is not None:
         rate = summary["error"] / max(1, summary["offered"])
         check("max_error_rate", rate <= a.max_error_rate,
@@ -391,7 +440,10 @@ def run_twin(scn: Scenario, *, smoke: bool = False,
         seed=use_seed,
     )
     summary = twin.run(records)
-    metrics = {"kv_pages_leaked": summary["kv_pages_leaked"]}
+    metrics = {
+        "kv_pages_leaked": summary["kv_pages_leaked"],
+        "prefix_hit_rate": summary.get("prefix", {}).get("hit_rate"),
+    }
     verdicts = evaluate(scn.assertions, summary, metrics)
     return {
         "scenario": scn.name,
@@ -451,16 +503,28 @@ def run_real(scn: Scenario, *, smoke: bool = False,
         texts = _wait_drained(rig)
         summary = report.summary()
         live_texts = [t for t in texts if t]
+        prefix_hits = _sum_metric(live_texts,
+                                  "serving_prefix_cache_hits_total")
+        prefix_misses = _sum_metric(live_texts,
+                                    "serving_prefix_cache_misses_total")
         metrics = {
             # every live replica permanently holds exactly one page (the
-            # KV manager's scratch page, allocated at construction and
-            # backing dummy rows) — anything above that at drain is a leak
+            # KV manager's scratch page) plus whatever distinct pages the
+            # prefix cache holds on purpose (serving_kv_pages_prefix_held)
+            # — anything above that at drain is a leak
             "kv_pages_leaked": int(sum(
                 max(0.0,
                     parse_prometheus_text(t).value("serving_kv_pages_used",
-                                                   0.0) - 1.0)
+                                                   0.0)
+                    - 1.0
+                    - parse_prometheus_text(t).value(
+                        "serving_kv_pages_prefix_held", 0.0))
                 for t in live_texts
             )),
+            "prefix_hit_rate": (
+                round(prefix_hits / (prefix_hits + prefix_misses), 4)
+                if (prefix_hits + prefix_misses) > 0 else None
+            ),
             "client_disconnects": int(
                 _sum_metric(live_texts, "serving_client_disconnects_total")
             ),

@@ -123,11 +123,20 @@ class TwinConfig:
     kv_pool_pages: Optional[int] = None
     kv_page_tokens: int = 8
     retry_on_shed: bool = True  # the router's sibling retry
+    # ISSUE 17: each replica keeps a prefix DIRECTORY — the set of
+    # cohort ids it has served. Admission prefers the replica already
+    # holding a row's cohort (the router's prefix-affinity hint), and a
+    # directory hit discounts the row's prefill to its unshared quarter
+    # (cohorts share 3/4 of their prompt; traces.prompt_tokens). Page
+    # accounting stays per-request — the twin models the LATENCY and
+    # PLACEMENT effects of the cache, not its pool residency.
+    prefix_cache: bool = False
+    prefix_affinity: bool = True
 
 
 class _Row:
     __slots__ = ("i", "arrive_t", "prompt_len", "max_new", "deadline",
-                 "disconnect_after_ms", "pages", "attempts")
+                 "disconnect_after_ms", "pages", "attempts", "prefix_group")
 
     def __init__(self, rec: TraceRequest, arrive_t: float, pages: int):
         self.i = rec.i
@@ -141,16 +150,20 @@ class _Row:
         self.disconnect_after_ms = rec.disconnect_after_ms
         self.pages = pages
         self.attempts = 0
+        self.prefix_group = rec.prefix_group
 
 
 class _Replica:
-    __slots__ = ("up", "queue", "batch", "pages_used")
+    __slots__ = ("up", "queue", "batch", "pages_used", "prefix_groups")
 
     def __init__(self):
         self.up = True
         self.queue: deque[_Row] = deque()
         self.batch: Optional[list[_Row]] = None
         self.pages_used = 0
+        # the per-replica prefix directory (ISSUE 17): cohort ids whose
+        # shared prefix this replica has prefilled and still holds
+        self.prefix_groups: set = set()
 
     def depth(self) -> int:
         return len(self.queue) + (len(self.batch) if self.batch else 0)
@@ -191,6 +204,9 @@ class ServingTwin:
         self._rng = random.Random(f"twin-reservoir:{seed}")
         self.offered = 0
         self.resolved = 0
+        # prefix-directory ledger (ISSUE 17)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
 
     # ------------------------------------------------------------ events
     def _push(self, t: float, kind: str, data) -> None:
@@ -208,6 +224,19 @@ class ServingTwin:
             (i for i, r in enumerate(self.replicas) if r.up),
             key=lambda i: self.replicas[i].depth(),
         )
+        # prefix affinity (ISSUE 17): a row whose cohort some replica's
+        # directory already holds goes there first — the twin models the
+        # router's stickiness without the imbalance yield (at twin scale
+        # JSQ keeps depths within one batch of each other anyway)
+        if (
+            self.cfg.prefix_cache
+            and self.cfg.prefix_affinity
+            and row.prefix_group is not None
+        ):
+            order.sort(
+                key=lambda i: row.prefix_group
+                not in self.replicas[i].prefix_groups
+            )
         if not self.cfg.retry_on_shed:
             order = order[:1]
         reason = "unavailable"
@@ -287,9 +316,21 @@ class ServingTwin:
                     1 + math.ceil(row.disconnect_after_ms / c.decode_step_ms),
                 )
             steps = max(steps, eff - 1)
+        prefill_tokens = 0
+        for row in batch:
+            toks = row.prompt_len
+            if self.cfg.prefix_cache and row.prefix_group is not None:
+                self.prefix_lookups += 1
+                if row.prefix_group in rep.prefix_groups:
+                    # directory hit: only the unshared quarter prefills
+                    # (cohorts share 3/4 of their prompt bytes)
+                    self.prefix_hits += 1
+                    toks = row.prompt_len - (3 * row.prompt_len) // 4
+                else:
+                    rep.prefix_groups.add(row.prefix_group)
+            prefill_tokens = max(prefill_tokens, toks)
         prefill_ms = (
-            c.batch_overhead_ms
-            + c.prefill_ms_per_token * max(r.prompt_len for r in batch)
+            c.batch_overhead_ms + c.prefill_ms_per_token * prefill_tokens
         )
         service_s = (prefill_ms + steps * c.decode_step_ms) / 1e3
         rep.batch = batch
@@ -332,6 +373,8 @@ class ServingTwin:
         rep.batch = None
         rep.queue.clear()
         rep.pages_used = 0
+        # warm KV died with the process; the directory empties with it
+        rep.prefix_groups.clear()
         for row in orphans:
             self._requeue(row, now)
 
@@ -385,6 +428,14 @@ class ServingTwin:
             "ttft_ms": {
                 "p50": quantile(ttft, 0.5),
                 "p99": quantile(ttft, 0.99),
+            },
+            "prefix": {
+                "lookups": self.prefix_lookups,
+                "hits": self.prefix_hits,
+                "hit_rate": (
+                    round(self.prefix_hits / self.prefix_lookups, 4)
+                    if self.prefix_lookups else None
+                ),
             },
             "sim_duration_s": round(self.clock.time(), 3),
         }

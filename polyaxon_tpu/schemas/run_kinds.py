@@ -193,6 +193,17 @@ class V1ServingSpec(BaseSchema):
     # them into `batch`. -1 means "fill from the visible device count".
     replicas: int | str = 1
     mesh_axes: Optional[dict[str, int | str]] = None
+    # cluster-wide tiered KV (ISSUE 17): prefixAffinity routes warm
+    # prompts to the replica already holding their prefix KV (fleet
+    # router knob, ignored at replicas=1); spillRamBytes bounds a
+    # host-RAM tier for evicted prefix-cache entries, spillDir +
+    # spillDirBytes add a CRC-framed on-disk tier below it — a prefix
+    # hit on a spilled entry restores pages instead of re-prefilling.
+    # Spill requires the paged pool with the prefix cache.
+    prefix_affinity: bool = True
+    spill_ram_bytes: Optional[int | str] = None
+    spill_dir: Optional[str] = None
+    spill_dir_bytes: Optional[int | str] = None
 
     _MESH_AXES_ALLOWED = ("batch", "model", "data", "fsdp")
 
@@ -276,6 +287,22 @@ class V1ServingSpec(BaseSchema):
                 "kvQuant requires the paged KV pool — set kvPoolPages "
                 "(dense per-group caches stay full-precision)"
             )
+        for name in ("spill_ram_bytes", "spill_dir_bytes"):
+            v = getattr(self, name)
+            if isinstance(v, int) and v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        if (self.spill_ram_bytes or self.spill_dir) and (
+            self.kv_pool_pages is None or not self.prefix_cache
+        ):
+            raise ValueError(
+                "spillRamBytes/spillDir require the paged KV pool with "
+                "the prefix cache — set kvPoolPages and keep prefixCache "
+                "on (spill tiers hold evicted prefix-cache entries)"
+            )
+        if self.spill_dir_bytes is not None and not self.spill_dir:
+            raise ValueError(
+                "spillDirBytes bounds the on-disk tier — set spillDir"
+            )
         if self.draft_model is not None and not self.speculate:
             raise ValueError(
                 "draftModel requires speculate: true (the draft model is "
@@ -355,6 +382,17 @@ class V1ServingSpec(BaseSchema):
             chunked_prefill=self.chunked_prefill,
             prefill_chunk_tokens=int(self.prefill_chunk_tokens),
             max_step_tokens=int(self.max_step_tokens),
+            spill_ram_bytes=(
+                int(self.spill_ram_bytes)
+                if self.spill_ram_bytes is not None
+                else None
+            ),
+            spill_dir=self.spill_dir,
+            spill_dir_bytes=(
+                int(self.spill_dir_bytes)
+                if self.spill_dir_bytes is not None
+                else None
+            ),
             mesh_axes=normalize_mesh_axes(
                 {ax: int(n) for ax, n in self.mesh_axes.items()}
                 if self.mesh_axes is not None

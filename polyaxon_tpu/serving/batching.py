@@ -233,6 +233,15 @@ class ServingConfig:
     chunked_prefill: bool = False
     prefill_chunk_tokens: int = 64
     max_step_tokens: int = 256
+    # tiered prefix spill (ISSUE 17): evicted PrefixCache entries demote
+    # to a host-RAM tier (spill_ram_bytes budget) and overflow to
+    # CRC-framed segment files under spill_dir (spill_dir_bytes budget;
+    # None = unbounded); a prefix hit on a spilled entry restores pages
+    # into the pool instead of re-prefilling. Requires kv_pool_pages +
+    # prefix_cache; int8 kv_quant halves spilled bytes in both tiers.
+    spill_ram_bytes: Optional[int] = None
+    spill_dir: Optional[str] = None
+    spill_dir_bytes: Optional[int] = None
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)

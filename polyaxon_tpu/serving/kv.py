@@ -40,14 +40,17 @@ import dataclasses
 import threading
 from typing import Callable, Optional
 
+from ..chaos.injector import inject
 from ..models.kv_pages import (
     PagedKVLayout,
     PagePool,
     PagePoolExhausted,
     PrefixCache,
     PrefixEntry,
+    page_hashes,
 )
 from .batching import ServingError, ShedError
+from .spill import SpillManager, SpillPayload
 
 
 @dataclasses.dataclass
@@ -86,6 +89,9 @@ class KVCacheManager:
         hash_fn=None,
         observer: Optional[Callable[..., None]] = None,
         kv_quant: str = "none",
+        spill_ram_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        spill_dir_bytes: Optional[int] = None,
     ):
         from ..models.generate import make_paged_cache
 
@@ -121,6 +127,45 @@ class KVCacheManager:
         self.active_rows = 0
         self.active_rows_hwm = 0
         self.harvest_skipped = 0
+        # ---- tiered prefix spill (ISSUE 17) -------------------------------
+        # Evicted prefix entries demote to host RAM / disk instead of
+        # vanishing; a later hit restores their pages into the pool. The
+        # host MIRROR holds each cached page's bytes keyed by the chain
+        # hash at that position (hash h_j commits to pages 0..j, so it
+        # uniquely names page j's content); `_mirror_refs[h]` counts live
+        # entries whose chain covers position h — bytes drop when the last
+        # covering entry evicts (and its spill payload has been built).
+        spill_on = bool(
+            (spill_ram_bytes or spill_dir) and self.prefix is not None
+        )
+        self._spill: Optional[SpillManager] = (
+            SpillManager(
+                ram_bytes=spill_ram_bytes or 0,
+                dir_path=spill_dir,
+                dir_bytes=spill_dir_bytes,
+            )
+            if spill_on
+            else None
+        )
+        if self._spill is not None:
+            self.prefix.on_evict = self._demote
+        self._mirror: dict[str, list] = {}  # hash -> per-leaf page bytes
+        self._mirror_refs: dict[str, int] = {}
+        # restores are a host decision at admission but a DEVICE write on
+        # the worker: plan_row queues (page_ids, per-leaf host arrays) and
+        # the worker flushes them before the next prefill touches the
+        # cache. Each pending item holds its own pool refs, so an eviction
+        # racing the flush is harmless (the write lands in held pages).
+        self._pending_restores: list = []
+        self._restore_fns: dict = {}
+        self.spill_restores = 0
+        self.restore_skipped = 0
+        self.restore_aborted = 0
+        self.spill_skipped = 0  # demotes with missing mirror bytes
+        self.mirror_capture_failures = 0
+        # 0, not the post-heal value: startup quarantines surface on the
+        # first opportunistic delta observation
+        self._quarantined_seen = 0
 
     # ------------------------------------------------------------- helpers
     def _observe(self, event: str, **ctx) -> None:
@@ -133,7 +178,12 @@ class KVCacheManager:
 
     def _pages_changed(self) -> None:
         self._observe(
-            "kv_pages", used=self.pool.used, total=self.pool.n_pages
+            "kv_pages",
+            used=self.pool.used,
+            total=self.pool.n_pages,
+            prefix_held=(
+                self.prefix.held_pages if self.prefix is not None else 0
+            ),
         )
 
     @property
@@ -168,6 +218,11 @@ class KVCacheManager:
         with self._lock:
             L, ppages, entry = 0, (), None
             if self.prefix is not None:
+                if self._spill is not None:
+                    # restore a spilled prefix BEFORE the lookup, so the
+                    # lookup below hits it and the hit/miss ledger stays
+                    # honest about what the request actually got
+                    self._maybe_restore(tokens, len(tokens) - 1)
                 # cap at len-1: prefill needs >= 1 suffix token to produce
                 # the first sampled logits
                 L, ppages, entry = self.prefix.lookup(
@@ -366,9 +421,13 @@ class KVCacheManager:
                     continue
                 n_new = k - Lp
                 if self.pool.available < n_new:
-                    # never eat admission headroom for cache warmth
-                    self.harvest_skipped += 1
-                    continue
+                    # demote idle LRU entries rather than dropping the
+                    # newest prompt: the freed pages net out against the
+                    # new entry's, so admission headroom is untouched —
+                    # and with a spill tier the evicted bytes survive.
+                    if not self.prefix.evict_for(n_new):
+                        self.harvest_skipped += 1
+                        continue
                 new_ids = self.pool.alloc(n_new)
                 table = list(plan.prefix_pages) + plan.own_pages
             count = n_new * pt
@@ -379,7 +438,26 @@ class KVCacheManager:
                 jnp.asarray(plan.prefix_len + int(pad), jnp.int32),
                 jnp.asarray(np.asarray(new_ids, np.int32)),
             )
+            # capture the harvested pages' host mirror NOW, on the worker
+            # thread, from the freshly scattered pool — the spill tier
+            # needs the bytes long after the device copy may be donated
+            mirror_pages = None
+            if self._spill is not None:
+                try:
+                    mirror_pages = self._capture_mirror(new_ids)
+                except Exception:  # noqa: BLE001 — spill is best-effort
+                    self.mirror_capture_failures += 1
             with self._lock:
+                hashes = (
+                    page_hashes(tokens[: k * pt], pt, self.prefix.hash_fn)
+                    if self._spill is not None
+                    else ()
+                )
+                if mirror_pages is not None:
+                    for idx in range(n_new):
+                        self._mirror.setdefault(
+                            hashes[Lp + idx], mirror_pages[idx]
+                        )
                 # index every chain link so partial-overlap prompts hit too
                 for j in range(Lp + 1, k + 1):
                     pages_j = tuple(plan.prefix_pages) + tuple(
@@ -387,12 +465,235 @@ class KVCacheManager:
                     )
                     if self.prefix.insert(tokens[: j * pt], pages_j):
                         inserted += 1
+                        self._mirror_ref(hashes[:j])
+                self._mirror_gc(hashes)
                 # drop the allocation refs — the entries hold their own
                 self.pool.unref(new_ids)
                 self._pages_changed()
             if trace is not None:
                 trace.annotate("kv_harvest_row", pages=n_new)
         return inserted
+
+    # ------------------------------------------------- tiered spill (ISSUE 17)
+    def _mirror_ref(self, hashes) -> None:
+        for h in hashes:
+            self._mirror_refs[h] = self._mirror_refs.get(h, 0) + 1
+
+    def _mirror_unref(self, hashes) -> None:
+        for h in hashes:
+            c = self._mirror_refs.get(h)
+            if c is None:
+                continue
+            if c <= 1:
+                del self._mirror_refs[h]
+                self._mirror.pop(h, None)
+            else:
+                self._mirror_refs[h] = c - 1
+
+    def _mirror_gc(self, hashes) -> None:
+        """Drop mirror bytes populated for positions no entry ended up
+        covering (insert lost a collision race)."""
+        for h in hashes:
+            if h not in self._mirror_refs:
+                self._mirror.pop(h, None)
+
+    def _capture_mirror(self, new_ids) -> list:
+        """Host copies of freshly written pool pages, per page per leaf.
+        Runs on the worker thread right after the producing program
+        returned — the only moment the bytes are guaranteed readable
+        before some later donated program invalidates the buffer."""
+        import jax
+        import numpy as np
+
+        scanned = bool(getattr(self.module.cfg, "scan_layers", False))
+        ids = np.asarray(new_ids, np.int32)
+        host = [
+            np.asarray(leaf[:, ids] if scanned else leaf[ids])
+            for leaf in jax.tree.leaves(self.cache)
+        ]
+        return [
+            [h[:, i] if scanned else h[i] for h in host]
+            for i in range(len(new_ids))
+        ]
+
+    def _observe_quarantine(self) -> None:
+        q = self._spill.quarantined
+        if q > self._quarantined_seen:
+            self._observe("kv_spill_quarantined", n=q - self._quarantined_seen)
+            self._quarantined_seen = q
+
+    def _demote(self, h: str, e: PrefixEntry) -> None:
+        """PrefixCache eviction hook: move the entry's bytes to the spill
+        tier instead of losing them. Runs under self._lock (every evict
+        path is inside a locked region) with the pages still referenced."""
+        hashes = page_hashes(e.tokens, self.layout.page_tokens, self.prefix.hash_fn)
+        try:
+            pages = []
+            for hj in hashes:
+                b = self._mirror.get(hj)
+                if b is None:
+                    # mirror capture failed/never happened for a position —
+                    # the entry just evicts the pre-spill way
+                    self.spill_skipped += 1
+                    pages = None
+                    break
+                pages.append(b)
+            if pages is not None:
+                payload = SpillPayload(tuple(e.tokens), tuple(hashes), pages)
+                if self._spill.put(payload):
+                    self._observe("kv_spill", bytes=payload.nbytes)
+                self._observe_quarantine()
+        finally:
+            self._mirror_unref(hashes)
+
+    def _maybe_restore(self, tokens, limit: int) -> None:
+        """Admission-time restore: if the spill tier holds a LONGER
+        verified prefix of `tokens` than the in-pool cache, pull its
+        pages back into the pool and re-index every chain link, so the
+        lookup that follows hits it. Caller holds self._lock."""
+        pt = self.layout.page_tokens
+        hashes = page_hashes(tokens[:limit], pt, self.prefix.hash_fn)
+        if not hashes:
+            return
+        _k_len, k_pages = self.prefix.peek(tokens, max_tokens=limit)
+        k = len(k_pages)
+        j = 0
+        for cand in range(len(hashes), k, -1):
+            if self._spill.has(hashes[cand - 1], tokens[: cand * pt]):
+                j = cand
+                break
+        if j == 0:
+            return
+        n_new = j - k
+        # same headroom rule as harvest: cache warmth never eats the
+        # admission headroom a reservation is about to need
+        if self.pool.available < n_new:
+            self.restore_skipped += 1
+            return
+        payload = self._spill.take(hashes[j - 1], tokens[: j * pt])
+        self._observe_quarantine()
+        if payload is None:
+            # corrupt/incomplete segment — quarantined, clean miss
+            return
+        try:
+            new_ids = self.pool.alloc(n_new)
+        except PagePoolExhausted:
+            self.restore_skipped += 1
+            return
+        queued = None
+        try:
+            # chaos: a kill here is a death mid-restore — the except arm
+            # below must return every page this restore holds (zero-leak)
+            inject("kv.restore", h=hashes[j - 1], pages=n_new)
+            queued = self._queue_restore(new_ids, payload.pages[k:])
+            for pos in range(1, j + 1):
+                self._mirror.setdefault(hashes[pos - 1], payload.pages[pos - 1])
+            inserted = 0
+            for jj in range(k + 1, j + 1):
+                pages_jj = tuple(k_pages) + tuple(new_ids[: jj - k])
+                if self.prefix.insert(tokens[: jj * pt], pages_jj):
+                    inserted += 1
+                    self._mirror_ref(hashes[:jj])
+            self._mirror_gc(hashes)
+            if inserted == 0:
+                # lost the admission race (hash slot taken by different
+                # content): cancel the queued device write, free its pages
+                self._pending_restores.remove(queued)
+                self.pool.unref(queued[0])
+                queued = None
+                self.restore_aborted += 1
+            else:
+                self.spill_restores += 1
+                self._observe("kv_spill_restore", pages=n_new)
+            self.pool.unref(new_ids)
+            self._pages_changed()
+        except BaseException:
+            if queued is not None:
+                try:
+                    self._pending_restores.remove(queued)
+                except ValueError:
+                    pass
+                else:
+                    self.pool.unref(queued[0])
+            self.pool.unref(new_ids)
+            raise
+
+    def _queue_restore(self, new_ids, pages_payload) -> tuple:
+        """Queue the device write for restored pages. The item holds its
+        OWN pool refs, so an eviction racing the flush is harmless — the
+        write lands in still-held pages, which free right after."""
+        import numpy as np
+
+        scanned = bool(getattr(self.module.cfg, "scan_layers", False))
+        n_leaves = len(pages_payload[0])
+        vals = [
+            np.stack(
+                [page[l] for page in pages_payload],
+                axis=1 if scanned else 0,
+            )
+            for l in range(n_leaves)
+        ]
+        self.pool.ref(new_ids)
+        item = (list(new_ids), vals)
+        self._pending_restores.append(item)
+        return item
+
+    def _restore_fn(self, n_new: int):
+        """Compiled scatter of `n_new` restored pages into the pool
+        (cache donated → in place), keyed like _harvest_fn."""
+        fn = self._restore_fns.get(n_new)
+        if fn is not None:
+            return fn
+        import jax
+
+        scanned = bool(getattr(self.module.cfg, "scan_layers", False))
+
+        def run(cache, ids, vals):
+            leaves, treedef = jax.tree.flatten(cache)
+            out = [
+                (leaf.at[:, ids].set(v) if scanned else leaf.at[ids].set(v))
+                for leaf, v in zip(leaves, vals)
+            ]
+            return jax.tree.unflatten(treedef, out)
+
+        fn = jax.jit(run, donate_argnums=(0,))
+        self._restore_fns[n_new] = fn
+        return fn
+
+    def flush_restores(self) -> int:
+        """Apply queued restore writes to the device pool. The decode
+        worker calls this right before a prefill dispatch (under the
+        server lock), so a restored row's first read sees its bytes.
+        Returns the number of restore batches applied."""
+        with self._lock:
+            if not self._pending_restores:
+                return 0
+            pending, self._pending_restores = self._pending_restores, []
+        import jax.numpy as jnp
+        import numpy as np
+
+        done = 0
+        for ids, vals in pending:
+            fn = self._restore_fn(len(ids))
+            self.cache = fn(
+                self.cache,
+                jnp.asarray(np.asarray(ids, np.int32)),
+                [jnp.asarray(v) for v in vals],
+            )
+            done += 1
+            with self._lock:
+                self.pool.unref(ids)
+                self._pages_changed()
+        return done
+
+    def advertised_heads(self) -> list[str]:
+        """Chain hashes restorable on this replica — resident PrefixCache
+        entries plus spilled entries in either tier. The /kvz payload."""
+        with self._lock:
+            heads = self.prefix.heads() if self.prefix is not None else []
+            if self._spill is not None:
+                heads.extend(self._spill.heads())
+            return list(dict.fromkeys(heads))
 
     # ---------------------------------------------------------------- stats
     def kv_pool_bytes(self) -> int:
@@ -428,5 +729,16 @@ class KVCacheManager:
                     "misses": self.prefix.misses,
                     "evictions": self.prefix.evictions,
                     "collisions": self.prefix.collisions,
+                }
+            if self._spill is not None:
+                out["spill"] = {
+                    **self._spill.stats(),
+                    "restores": self.spill_restores,
+                    "restore_skipped": self.restore_skipped,
+                    "restore_aborted": self.restore_aborted,
+                    "spill_skipped": self.spill_skipped,
+                    "mirror_entries": len(self._mirror),
+                    "mirror_capture_failures": self.mirror_capture_failures,
+                    "pending_restores": len(self._pending_restores),
                 }
             return out

@@ -3,22 +3,39 @@
 One replica caps serving throughput at one coalescer and makes every
 redeploy an outage; the router is the horizontal layer that turns a set
 of replicas into one service. It is deliberately model-free — no jax
-import, no tokens parsed on the happy path — so it forwards bytes at
-HTTP speed while the replicas do the math:
+import, and tokens are parsed off the wire only when prefix affinity has
+somewhere to send them — so it forwards bytes at HTTP speed while the
+replicas do the math:
 
 **Discovery + health** — a poll loop re-reads the endpoint provider
 (static list or `ReplicaSetManager.endpoints`) and probes each replica's
-`/readyz` and `/metricsz` every `poll_interval_s`. A replica is routable
-when ready and not marked draining; its scraped `serving_queue_depth`
-and the delta of `serving_queue_wait_seconds_sum/_count` between polls
-feed the balancer.
+`/readyz`, `/metricsz`, and `/kvz` every `poll_interval_s`. A replica is
+routable when ready and not marked draining; its scraped
+`serving_queue_depth` and the delta of
+`serving_queue_wait_seconds_sum/_count` between polls feed the balancer,
+its `/metricsz` text is parsed ONCE per poll and that one snapshot feeds
+the balancer, `/statsz` cluster rollups, and metrics federation alike,
+and its `/kvz` prefix advertisement feeds the affinity directory.
 
 **Balancing** — join-shortest-queue with power-of-two-choices: two
 distinct candidates are sampled (seeded RNG, deterministic in tests) and
 the one with the smaller (router-local in-flight + scraped queue depth,
-queue-wait) score wins. In-flight counts are the router's own, updated
-synchronously around each forward, so the signal does not stale between
-scrapes the way pure JSQ-on-metrics would.
+weighted by the replica's scraped device count so a 2x slice absorbs 2x
+queue, queue-wait tiebreak) score wins. In-flight counts are the
+router's own, updated synchronously around each forward, so the signal
+does not stale between scrapes the way pure JSQ-on-metrics would.
+
+**Prefix affinity (ISSUE 17)** — replicas advertise the content-hash
+chain heads of their resident + spilled KV prefixes on `/kvz`; the
+router keeps a `serving/affinity.py` PrefixDirectory and, when a
+routable replica holds a prefix of the incoming prompt, routes there
+first so the warm replica reuses (or restores from spill) the prefill
+instead of a cold sibling re-computing it. Stickiness yields to load:
+when the best holder's weighted queue exceeds the fleet minimum by more
+than `affinity_imbalance`, the request falls back to plain JSQ+P2C —
+a hot prefix must not melt one replica while siblings idle. The
+directory is a hint; the replica re-verifies token content, so stale
+advertisements cost one prefill, never wrong KV.
 
 **Retry on sibling** — a 503 shed is, by the replica's own contract,
 "never queued, safe to retry" (serving/batching.py), so the router
@@ -60,12 +77,15 @@ from ..telemetry import (
     now as _now,
 )
 from ..telemetry.federate import (
+    PromSnapshot,
     federate,
     parse_prometheus_text,
     queue_wait_delta_ms,
+    sum_values,
 )
 from ..telemetry.slo import AvailabilityObjective, SLOEngine
 from ..telemetry.tracing import graft_spans, tracez_payload
+from .affinity import PrefixDirectory
 
 # replica 503 reasons that must NOT be replayed on a sibling: the
 # request's own budget is spent, not the replica's
@@ -106,6 +126,17 @@ class ReplicaState:
     # last successful /metricsz scrape, verbatim — the federation source
     # (None = last scrape failed: federation_source_up goes 0)
     metrics_text: Optional[str] = None
+    # the SAME scrape parsed once (satellite of ISSUE 17): balancer,
+    # federation, cluster_stats, and the prefix directory all read this
+    # snapshot instead of re-parsing the text per consumer
+    metrics_snap: Optional[PromSnapshot] = None
+    # scraped capacity weight (serving_mesh_devices): a 2x slice absorbs
+    # 2x queue before weighted-JSQ considers it equally loaded
+    weight: float = 1.0
+    # /kvz advertisement: page size of this replica's KV pool (0 = no
+    # paged KV / prefix cache disabled / scrape failed)
+    kv_page_tokens: int = 0
+    kv_heads: int = 0  # advertised prefix head count (stats surface)
     # last scraped cumulative queue-wait sums, for the delta
     _wait_sum: float = 0.0
     _wait_count: float = 0.0
@@ -114,9 +145,14 @@ class ReplicaState:
     def routable(self) -> bool:
         return self.healthy and not self.draining
 
+    def load(self) -> float:
+        """Weighted effective queue: (router-local in-flight + scraped
+        depth) per unit of scraped capacity."""
+        return (self.inflight + self.queue_depth) / max(self.weight, 1e-9)
+
     def score(self) -> tuple[float, float]:
-        """JSQ key: shortest effective queue first, queue-wait tiebreak."""
-        return (self.inflight + self.queue_depth, self.queue_wait_ms)
+        """JSQ key: shortest weighted queue first, queue-wait tiebreak."""
+        return (self.load(), self.queue_wait_ms)
 
 
 class P2CBalancer:
@@ -191,6 +227,8 @@ class Router:
         trace_ring: int = 256,
         stitch: bool = True,
         federate: bool = True,
+        affinity: bool = True,
+        affinity_imbalance: float = 4.0,
     ):
         self._provider: Callable[[], Sequence[str]] = (
             endpoints if callable(endpoints) else (lambda: endpoints)
@@ -225,6 +263,18 @@ class Router:
         self._m_healthy_total = self.telemetry.gauge(
             "router.replicas_routable",
             help="Replicas currently healthy and not draining",
+        )
+        # prefix-affinity routing (ISSUE 17): replicas advertise resident
+        # prefix heads on /kvz; warm prompts stick to their holder unless
+        # its weighted load exceeds the fleet minimum by more than
+        # `affinity_imbalance` effective-queue units
+        self.affinity_enabled = bool(affinity)
+        self.affinity_imbalance = float(affinity_imbalance)
+        self.directory = PrefixDirectory()
+        self._m_affinity_hits = self.telemetry.counter(
+            "router.affinity_hits",
+            help="Requests routed to a replica advertising a prefix of "
+            "the prompt (cluster-wide warm-KV reuse)",
         )
         # cluster observability plane: router-side request traces (with
         # the replica-side timeline grafted in) + metrics federation
@@ -325,10 +375,16 @@ class Router:
             # keep last-known queue signal for balancing, but mark the
             # federation source down — an absent replica must be visible
             s.metrics_text = None
+            s.metrics_snap = None
+            self._probe_kv(s)
             return
+        # parse ONCE: this snapshot serves the balancer (below), metrics
+        # federation, and /statsz cluster rollups for the whole interval
         snap = parse_prometheus_text(text)
         s.metrics_text = text
+        s.metrics_snap = snap
         s.queue_depth = snap.value("serving_queue_depth", 0.0)
+        s.weight = snap.value("serving_mesh_devices", 0.0) or 1.0
         delta_ms, wsum, wcount = queue_wait_delta_ms(
             snap, s._wait_sum, s._wait_count
         )
@@ -340,6 +396,27 @@ class Router:
                 else 0.5 * s.queue_wait_ms + 0.5 * delta_ms
             )
         s._wait_sum, s._wait_count = wsum, wcount
+        self._probe_kv(s)
+
+    def _probe_kv(self, s: ReplicaState) -> None:
+        """Refresh the prefix directory from the replica's `/kvz`
+        advertisement (same poll pass as /metricsz — no extra cadence).
+        Any failure, including an older replica 404ing the route, clears
+        the replica's entry: no advertisement, no affinity."""
+        if not self.affinity_enabled:
+            return
+        try:
+            with urlrequest.urlopen(
+                s.url + "/kvz", timeout=self.probe_timeout_s
+            ) as r:
+                adv = json.loads(r.read())
+            heads = adv.get("heads") or []
+            pt = int(adv.get("pageTokens") or 0) if adv.get("enabled") else 0
+        except Exception:
+            heads, pt = [], 0
+        s.kv_page_tokens = pt
+        s.kv_heads = len(heads) if pt else 0
+        self.directory.update(s.slug, pt, heads)
 
     def poll_once(self) -> None:
         """One discovery + health pass (the loop body; tests call it
@@ -359,6 +436,12 @@ class Router:
                 f"router.replica_queue_depth.{s.slug}",
                 help="Scraped coalescer queue depth",
             ).set(s.queue_depth)
+            if self.affinity_enabled:
+                self.telemetry.gauge(
+                    f"router.replica_prefix_heads.{s.slug}",
+                    help="Prefix chain heads the replica advertises on "
+                    "/kvz (resident + spilled)",
+                ).set(s.kv_heads)
         self._m_healthy_total.set(
             sum(1 for s in self.states() if s.routable)
         )
@@ -423,6 +506,48 @@ class Router:
                 s for s in self._states if not s.draining
             ] or list(self._states)
 
+    def _order(
+        self, body: bytes, trace: Optional[RequestTrace] = None
+    ) -> list[ReplicaState]:
+        """Candidate order for one request: affinity-first when some
+        candidate advertises a prefix of the prompt (and isn't drowning),
+        else plain JSQ+P2C. The body is parsed for tokens ONLY when the
+        directory is non-empty — an affinity-less fleet keeps the
+        zero-parse happy path."""
+        candidates = self._candidates()
+        order = self.balancer.order(candidates)
+        if (
+            not self.affinity_enabled
+            or len(order) < 2  # nothing to choose between
+            or self.directory.empty
+        ):
+            return order
+        tokens = _first_row_tokens(body)
+        if not tokens:
+            return order
+        matches = self.directory.match(tokens)
+        holders = [s for s in order if matches.get(s.slug)]
+        if not holders:
+            return order
+        # longest prefix wins; weighted load breaks ties between holders
+        best = min(holders, key=lambda s: (-matches[s.slug], s.score()))
+        # stickiness yields to imbalance: a hot prefix must not melt its
+        # holder while siblings idle
+        min_load = min(s.load() for s in order)
+        if best.load() - min_load > self.affinity_imbalance:
+            if trace is not None:
+                trace.annotate(
+                    "affinity_overload", replica=best.slug,
+                    pages=matches[best.slug],
+                )
+            return order
+        self._m_affinity_hits.inc()
+        if trace is not None:
+            trace.annotate(
+                "affinity", replica=best.slug, pages=matches[best.slug]
+            )
+        return [best, *[s for s in order if s is not best]]
+
     def forward(
         self,
         body: bytes,
@@ -435,7 +560,7 @@ class Router:
         headers) of the first acceptable upstream answer — payload bytes
         verbatim, so the client sees exactly what the replica wrote."""
         t_bal = _now()
-        order = self.balancer.order(self._candidates())
+        order = self._order(body, trace)
         if trace is not None:
             trace.add(
                 "balance", start=t_bal, dur_s=_now() - t_bal,
@@ -552,7 +677,7 @@ class Router:
         sent: dict[int, int] = {}  # row → tokens already delivered
         done_rows: set[int] = set()
         t_bal = _now()
-        order = self.balancer.order(self._candidates())
+        order = self._order(body, trace)
         if trace is not None:
             trace.add(
                 "balance", start=t_bal, dur_s=_now() - t_bal,
@@ -827,21 +952,27 @@ class Router:
         local = self.telemetry.render_prometheus()
         if not self.federate_enabled:
             return local
+        # pass the poll loop's parsed snapshots: federate() re-renders
+        # them without re-parsing the exposition text (ISSUE 17)
         sources = [
-            (s.slug, s.metrics_text) for s in self.states()
+            (s.slug, s.metrics_snap if s.metrics_snap is not None
+             else s.metrics_text)
+            for s in self.states()
         ]
         return federate(sources, label="replica", local_text=local)
 
     def cluster_stats(self) -> dict:
         """Fleet-level rollup for `/statsz` (what `polyaxon top` renders):
         sums/maxes over the replicas' scraped series plus router-local
-        inflight — no extra scrape, just the poll loop's last pass."""
+        inflight — no extra scrape, no re-parse: the poll loop's one
+        parsed snapshot per replica serves this too (ISSUE 17)."""
         states = self.states()
-        snaps = [
-            parse_prometheus_text(s.metrics_text)
-            for s in states
-            if s.metrics_text
-        ]
+        snaps = [s.metrics_snap for s in states if s.metrics_snap]
+        prefix_hits = sum_values(snaps, "serving_prefix_cache_hits_total")
+        prefix_misses = sum_values(
+            snaps, "serving_prefix_cache_misses_total"
+        )
+        looked = prefix_hits + prefix_misses
         return {
             "federation": self.federate_enabled,
             "replicas": len(states),
@@ -851,12 +982,18 @@ class Router:
             "queue_wait_ms_max": round(
                 max((s.queue_wait_ms for s in states), default=0.0), 3
             ),
-            "serving_requests": sum(
-                snap.value("serving_requests_total") for snap in snaps
+            "serving_requests": sum_values(snaps, "serving_requests_total"),
+            "serving_shed": sum_values(snaps, "serving_shed_total"),
+            # cluster-wide warm-KV picture (ISSUE 17)
+            "prefix_hits": prefix_hits,
+            "prefix_misses": prefix_misses,
+            "prefix_hit_rate": (
+                round(prefix_hits / looked, 4) if looked else None
             ),
-            "serving_shed": sum(
-                snap.value("serving_shed_total") for snap in snaps
+            "spill_restores": sum_values(
+                snaps, "serving_kv_spill_restores_total"
             ),
+            "spill_bytes": sum_values(snaps, "serving_kv_spill_bytes_total"),
         }
 
     # ------------------------------------------------------------- stats
@@ -872,6 +1009,8 @@ class Router:
                 "queue_wait_ms": round(s.queue_wait_ms, 3),
                 "inflight": s.inflight,
                 "requests": s.requests,
+                "weight": s.weight,
+                "prefix_heads": s.kv_heads,
             }
             for s in self.states()
         ]
@@ -896,6 +1035,12 @@ class Router:
                 for k in ("p50", "p95", "p99", "mean")
             },
             "autoscale": auto,
+            "affinity": {
+                "enabled": self.affinity_enabled,
+                "imbalance": self.affinity_imbalance,
+                "hits": int(self._m_affinity_hits.value),
+                **self.directory.stats(),
+            },
             "tracing": {
                 "enabled": self.trace_enabled,
                 "stitch": self.stitch_enabled,
@@ -1124,6 +1269,20 @@ class _StreamError(Exception):
         self.payload = payload
         self.headers = headers
         self.retryable = retryable
+
+
+def _first_row_tokens(body: bytes) -> Optional[list]:
+    """Prompt tokens of the request's first row, or None when the body
+    isn't the /generate shape (the replica will reject it anyway — the
+    router never fails a request over affinity parsing)."""
+    try:
+        rows = json.loads(body).get("tokens")
+        row = rows[0]
+        if not isinstance(row, list):
+            return None
+        return row
+    except Exception:
+        return None
 
 
 def _iter_sse_frames(resp):

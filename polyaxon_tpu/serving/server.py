@@ -209,6 +209,13 @@ class ModelServer:
             raise ValueError(
                 "draft_model/adaptive_draft require speculate=True"
             )
+        if (self.config.spill_ram_bytes or self.config.spill_dir) and not (
+            self.config.kv_pool_pages and self.config.prefix_cache
+        ):
+            raise ValueError(
+                "spill_ram_bytes/spill_dir require the paged KV pool with "
+                "the prefix cache (set kv_pool_pages, keep prefix_cache on)"
+            )
         # int8 quantize-on-load (ISSUE 8): rebuild the module with the
         # Int8Dense projection path and transform the restored fp params
         # BEFORE anything captures them — the dense projection kernels
@@ -367,6 +374,12 @@ class ModelServer:
             "serving.kv_pages_used",
             help="KV pages currently allocated (incl. scratch + prefix cache)",
         )
+        self._m_kv_prefix_held = self.telemetry.gauge(
+            "serving.kv_pages_prefix_held",
+            help="Distinct KV pages held only on behalf of the prefix "
+            "cache — warm state, not a leak; drain accounting subtracts "
+            "this from kv_pages_used",
+        )
         self._m_prefix_hits = self.telemetry.counter(
             "serving.prefix_cache_hits",
             help="Requests whose prompt prefix was served from cached KV",
@@ -374,6 +387,24 @@ class ModelServer:
         self._m_prefix_misses = self.telemetry.counter(
             "serving.prefix_cache_misses",
             help="Requests that found no cached KV prefix",
+        )
+        # tiered prefix spill series (ISSUE 17) — registered from startup
+        # (zeros when spill is off) so the canary's affinity gate can
+        # scrape them unconditionally
+        self._m_spill_bytes = self.telemetry.counter(
+            "serving.kv_spill_bytes",
+            help="Bytes of evicted KV prefixes accepted into the spill "
+            "tiers (host RAM / disk) instead of being discarded",
+        )
+        self._m_spill_restores = self.telemetry.counter(
+            "serving.kv_spill_restores",
+            help="Spilled prefixes restored into the page pool on a hit "
+            "(each one is a prefill the cluster did not repeat)",
+        )
+        self._m_spill_quarantined = self.telemetry.counter(
+            "serving.kv_spill_quarantined",
+            help="Corrupt spill segments quarantined to <seg>.corrupt and "
+            "served as clean misses",
         )
         # fast-decode series (ISSUE 8) — registered from startup (zeros
         # when speculation/quant are off) so the canary's spec gate can
@@ -530,6 +561,9 @@ class ModelServer:
                 prefix_cache=bool(self.config.prefix_cache),
                 observer=self._kv_observe,
                 kv_quant=str(self.config.kv_quant or "none"),
+                spill_ram_bytes=self.config.spill_ram_bytes,
+                spill_dir=self.config.spill_dir,
+                spill_dir_bytes=self.config.spill_dir_bytes,
             )
             self._m_kv_total.set(self._kv.pool.n_pages)
             self._m_kv_used.set(self._kv.pool.used)
@@ -602,6 +636,7 @@ class ModelServer:
         """KVCacheManager → registry bridge (same pipeline as _observe)."""
         if event == "kv_pages":
             self._m_kv_used.set(ctx["used"])
+            self._m_kv_prefix_held.set(ctx.get("prefix_held", 0))
         elif event == "prefix_hit":
             self._m_prefix_hits.inc()
         elif event == "prefix_miss":
@@ -611,6 +646,12 @@ class ModelServer:
                 "serving.prefix_cache_evictions",
                 help="Prefix-cache entries LRU-evicted to admit new requests",
             ).inc()
+        elif event == "kv_spill":
+            self._m_spill_bytes.inc(int(ctx.get("bytes", 0)))
+        elif event == "kv_spill_restore":
+            self._m_spill_restores.inc()
+        elif event == "kv_spill_quarantined":
+            self._m_spill_quarantined.inc(int(ctx.get("n", 1)))
         elif event == "shed":
             self._observe("shed", **ctx)
 
@@ -1380,6 +1421,9 @@ class ModelServer:
         kv.ensure_pages(plans[:n], upto_slot=L + pb, traces=traces)
         tables = kv.tables(plans, bb, n_pages)
         with self._lock:
+            # land any queued spill restores before the prefill reads
+            # restored prefix pages (ISSUE 17)
+            kv.flush_restores()
             fn = self._paged_prefill_fn(
                 bb, pb, L, n_pages, key.temperature, key.top_k
             )
@@ -1670,6 +1714,9 @@ class ModelServer:
         kv.ensure_pages(plans[:n], upto_slot=L + pb, traces=traces)
         tables = kv.tables(plans, bb, n_pages)
         with self._lock:
+            # land any queued spill restores before the prefill reads
+            # restored prefix pages (ISSUE 17)
+            kv.flush_restores()
             fn = self._paged_prefill_fn(
                 bb, pb, L, n_pages, key.temperature, key.top_k
             )
@@ -2159,6 +2206,18 @@ class ModelServer:
     def _ms(v) -> Optional[float]:
         return round(v * 1e3, 3) if v is not None else None
 
+    def kv_heads(self) -> dict:
+        """GET /kvz payload: the prefix chain hashes this replica holds
+        (in-pool or spilled), keyed by the pool's page size so the router
+        hashes request prompts the same way."""
+        if self._kv is None:
+            return {"enabled": False, "pageTokens": 0, "heads": []}
+        return {
+            "enabled": self._kv.prefix is not None,
+            "pageTokens": self._kv.layout.page_tokens,
+            "heads": self._kv.advertised_heads(),
+        }
+
     def stats(self) -> dict:
         batches = rows = 0
         resilience = {}
@@ -2375,6 +2434,14 @@ class ModelServer:
                         server.telemetry.render_prometheus().encode(),
                         "text/plain; version=0.0.4",
                     )
+                elif path == "/kvz":
+                    # prefix-affinity advertisement (ISSUE 17): the chain
+                    # hashes this replica can serve warm — resident
+                    # PrefixCache entries plus restorable spilled ones.
+                    # The router's directory scrapes this alongside
+                    # /metricsz; staleness is harmless (a stale hit just
+                    # re-prefills or restores, never serves wrong bytes)
+                    self._send(200, server.kv_heads())
                 elif path == "/tracez":
                     self._tracez(query)
                 elif path == "/sloz":
@@ -2652,6 +2719,9 @@ class _StepEngine:
         pls = np.asarray([st.L], np.int32)
         seeds = np.asarray([r.seed], np.int32)
         with s._lock:
+            # land any queued spill restores before the chunk reads
+            # restored prefix pages (ISSUE 17)
+            kv.flush_restores()
             fn = s._prefill_chunk_fn(final, key.temperature, key.top_k)
             out = fn(
                 s.params,

@@ -227,7 +227,7 @@ def _is_counter_shaped(name: str, types: dict[str, str]) -> bool:
 
 
 def federate(
-    sources: Sequence[tuple[str, Optional[str]]],
+    sources: Sequence[tuple[str, "Optional[str | PromSnapshot]"]],
     *,
     label: str = "replica",
     local_text: str = "",
@@ -236,8 +236,12 @@ def federate(
 ) -> str:
     """Merge N scraped exposition texts into one federated text.
 
-    ``sources`` is ``[(slug, text_or_None), ...]`` — ``None`` marks a
-    failed scrape; the source still appears as
+    ``sources`` is ``[(slug, text_or_snapshot_or_None), ...]`` — a
+    source may be raw exposition text OR an already-parsed
+    :class:`PromSnapshot` (the router's poll loop parses each scrape
+    exactly once and hands the snapshot to the balancer, the stats
+    rollup, and federation alike — no per-consumer re-parse). ``None``
+    marks a failed scrape; the source still appears as
     ``federation_source_up{<label>="<slug>"} 0`` so an absent replica is
     visible, not silent. Every source sample is re-emitted with
     ``<label>="<slug>"`` merged into its labels (a pre-existing label of
@@ -265,7 +269,11 @@ def federate(
         )
         if text is None:
             continue
-        snap = parse_prometheus_text(text)
+        snap = (
+            text
+            if isinstance(text, PromSnapshot)
+            else parse_prometheus_text(text)
+        )
         types.update(snap.types)
         for s in snap.samples:
             merged = {**s.labels, label: slug}

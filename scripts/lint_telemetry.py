@@ -145,6 +145,21 @@ seed would stop replaying the same soak. Any direct `time.time/
 monotonic/perf_counter/sleep` (and `_ns` variants) or
 `datetime.now/utcnow/today` call in that directory is forbidden.
 
+Fourteenth rule: NO raw clock in the tiered-KV spill/directory modules.
+The spill store (`polyaxon_tpu/serving/spill.py`) orders its RAM-tier
+LRU by insertion order and its disk tier by segment sequence number,
+and the router-side prefix directory
+(`polyaxon_tpu/serving/affinity.py`) is a pure map from poll-loop
+advertisements to candidate ordering — freshness is "whatever the last
+poll wrote", never an age in seconds. A raw `time.*()` /
+`datetime.now()` read in either would couple spill/restore order and
+affinity decisions to the host clock: the chaos replays (kill mid-
+spill, corrupt-segment quarantine) and the scenario twin's prefix
+model would stop reproducing. Any direct `time.time/monotonic/
+perf_counter/sleep` (and `_ns` variants) or `datetime.now/utcnow/
+today` call in those two files is forbidden: order by logical
+sequence, measure in the server layer on the telemetry clock.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -238,6 +253,16 @@ SCENARIO_PATTERN = re.compile(
 #: the scenario engine replays: traces are pure functions of their seed,
 #: the twin rides SimClock, the driver measures on telemetry.now() and
 #: waits on threading.Event (rule 13)
+SPILL_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: tiered-KV spill orders by logical sequence, the prefix directory by
+#: the last poll's advertisement — no time axis (rule 14)
+SPILL_MODULES = (
+    ("polyaxon_tpu", "serving", "spill.py"),
+    ("polyaxon_tpu", "serving", "affinity.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -284,6 +309,7 @@ def violations(repo_root: Path) -> list[str]:
         in_steps = rel.parts in STEPS_MODULES
         in_adaptive = rel.parts in ADAPTIVE_MODULES
         in_scenarios = rel.parts[:2] == ("polyaxon_tpu", "scenarios")
+        in_spill = rel.parts in SPILL_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -355,6 +381,14 @@ def violations(repo_root: Path) -> list[str]:
                     f"traces replay from their seed, the twin rides "
                     f"SimClock; measure via telemetry.now(), wait via "
                     f"threading.Event.wait: {line.strip()}"
+                )
+            if in_spill and SPILL_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in tiered-KV spill/affinity "
+                    f"— spill orders by logical sequence, the prefix "
+                    f"directory by the last poll's advertisement; "
+                    f"durations belong to the server layer: "
+                    f"{line.strip()}"
                 )
     return out
 

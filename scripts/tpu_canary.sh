@@ -1173,6 +1173,163 @@ PY
       echo "FEDERATION-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # cluster-KV gate (ISSUE 17): a warm shared-prefix cohort through a
+    # 2-replica router must STICK to the replica holding its prefix KV
+    # (>= 1 affinity hit), survive eviction through a spill -> restore
+    # cycle with byte-identical output, and the affinity + spill series
+    # (router_affinity_hits_total, serving_kv_spill_*_total, the
+    # cluster prefix-hit aggregate) must be live on one router scrape.
+    # A fleet whose warm traffic scatters or whose spill tier is dark
+    # FAILS.
+    echo "running cluster-KV affinity smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.retry import RetryPolicy
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.replicas import InProcessReplica, ReplicaSetManager
+from polyaxon_tpu.serving.router import P2CBalancer, Router
+from polyaxon_tpu.serving.server import ModelServer
+from polyaxon_tpu.telemetry import MetricsRegistry
+from polyaxon_tpu.telemetry.federate import parse_prometheus_text
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+
+
+def make_server():
+    # pool sized so ~4 distinct cached prompts force harvest to demote
+    # (each 49-token prompt caches 6 pages of 8 tokens; pool holds 24)
+    return ModelServer(
+        b.module, params,
+        config=ServingConfig(max_batch=4, max_wait_ms=10.0,
+                             kv_pool_pages=24, kv_page_tokens=8,
+                             spill_ram_bytes=32 << 20),
+    )
+
+
+reg = MetricsRegistry()
+mgr = ReplicaSetManager(
+    lambda i: InProcessReplica(make_server), replicas=2,
+    retry=RetryPolicy(max_retries=3, backoff=0.1),
+    registry=reg, monitor_interval_s=0.2,
+)
+router = Router(
+    mgr.endpoints, registry=reg, balancer=P2CBalancer(seed=7),
+    poll_interval_s=0.2,
+)
+mgr.attach_router(router)
+mgr.start()
+port = router.start("127.0.0.1", 0)
+try:
+    router.poll_once()
+
+    def post(tokens):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"tokens": [list(tokens)], "maxNewTokens": 6,
+                             "temperature": 0.0, "seed": 0}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            if r.status != 200:
+                print("cluster-kv smoke: request failed", r.status)
+                sys.exit(1)
+            return json.loads(r.read())["tokens"]
+
+    rng = np.random.RandomState(0)
+    # the flood splits across both replicas, so it is sized for the
+    # HOLDER's share alone to overflow its pool (24 pages, 6 per prompt)
+    target, *flood = [rng.randint(1, 100, size=49).tolist()
+                      for _ in range(17)]
+
+    cold = post(target)  # harvests the target prefix on one replica
+    deadline = time.monotonic() + 10
+    while router.directory.empty and time.monotonic() < deadline:
+        time.sleep(0.1)
+        router.poll_once()  # pick up the /kvz advertisement
+    if router.directory.empty:
+        print("cluster-kv smoke: no replica ever advertised a prefix")
+        sys.exit(1)
+
+    hits_before = router._m_affinity_hits.value
+    warm = post(target)  # must stick to the holder: affinity + KV hit
+    if router._m_affinity_hits.value <= hits_before:
+        print("cluster-kv smoke: warm repeat produced no affinity hit")
+        sys.exit(1)
+    if warm != cold:
+        print("cluster-kv smoke: warm bytes diverged", warm, cold)
+        sys.exit(1)
+
+    # churn both pools with distinct prompts so the target's cached
+    # pages demote to the RAM spill tier, then repeat the target: the
+    # hit must RESTORE from spill, still byte-identical
+    for f in flood:
+        post(f)
+    router.poll_once()
+    restored = post(target)
+    if restored != cold:
+        print("cluster-kv smoke: restored bytes diverged", restored, cold)
+        sys.exit(1)
+
+    router.poll_once()  # re-scrape: replica texts include the cycle
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+finally:
+    router.stop()
+    mgr.stop()
+with open("tpu_results/cluster_kv_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "router_affinity_hits_total",
+    "serving_kv_spill_bytes_total",
+    "serving_kv_spill_restores_total",
+    "serving_kv_spill_quarantined_total",
+    "cluster:serving_prefix_cache_hits_total:sum",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("cluster-kv smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+snap = parse_prometheus_text(text)
+spilled = snap.get("cluster:serving_kv_spill_bytes_total:sum") or 0
+restores = snap.get("cluster:serving_kv_spill_restores_total:sum") or 0
+kv_hits = snap.get("cluster:serving_prefix_cache_hits_total:sum") or 0
+problems = []
+if spilled <= 0:
+    problems.append(f"no bytes ever spilled ({spilled})")
+if restores < 1:
+    problems.append(f"no spill restore fired ({restores})")
+if kv_hits < 1:
+    problems.append(f"no cluster prefix-cache hit ({kv_hits})")
+if problems:
+    print("cluster-kv smoke:", "; ".join(problems))
+    sys.exit(1)
+print(f"cluster-KV affinity smoke: ok ({len(required)} required series "
+      f"present, {int(router._m_affinity_hits.value)} affinity hits, "
+      f"{int(spilled)} bytes spilled, {int(restores)} restore(s), "
+      f"{int(kv_hits)} cluster prefix hit(s), byte-identical warm + "
+      f"restored output)")
+PY
+    then
+      echo "CLUSTER-KV-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     # event-log crash gate: a REAL run through the Agent/Fleet stack,
     # then the store writer takes a real SIGKILL mid-append (seeded
     # garbage lands on the live segment first — the torn tail a power

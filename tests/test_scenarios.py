@@ -160,6 +160,38 @@ def test_twin_replica_down_fails_over_without_hangs():
                     faults=[{"kind": "meteor_strike"}])
 
 
+def test_twin_prefix_directory_models_affinity_and_hit_rate():
+    # ISSUE 17: each twin replica keeps a prefix directory; affinity
+    # steers cohort repeats to the replica that already prefilled the
+    # shared prefix, so only a handful of cold prefills happen
+    # near-simultaneous arrivals: queues build, so JSQ genuinely spreads
+    # rows across both replicas and affinity has a decision to make
+    recs = lambda: tr.generate("shared_prefix", 5, n=60, rps=2000.0,  # noqa: E731
+                               cohorts=3)
+    on = _twin(TwinConfig(replicas=2, prefix_cache=True,
+                          kv_pool_pages=64)).run(recs())
+    p = on["prefix"]
+    assert p["lookups"] > 0 and p["hits"] > 0
+    assert p["hit_rate"] >= 0.5, p
+    assert on["hung"] == 0 and on["kv_pages_leaked"] == 0
+    # without affinity, JSQ spreads each cohort across BOTH replicas —
+    # every replica pays its own cold prefill, so strictly fewer hits
+    off = _twin(TwinConfig(replicas=2, prefix_cache=True,
+                           kv_pool_pages=64, prefix_affinity=False)).run(
+        recs())
+    assert off["prefix"]["hits"] < p["hits"], (off["prefix"], p)
+    # a replica death empties its directory with its pages
+    dead = _twin(
+        TwinConfig(replicas=2, prefix_cache=True, kv_pool_pages=64),
+        faults=[{"kind": "replica_down", "replica": 0, "at_s": 0.5,
+                 "duration_s": 0.5}],
+    ).run(recs())
+    assert dead["hung"] == 0 and dead["kv_pages_leaked"] == 0
+    # prefix off: the ledger stays empty and hit_rate is None
+    plain = _twin(TwinConfig(replicas=2, kv_pool_pages=64)).run(recs())
+    assert plain["prefix"] == {"lookups": 0, "hits": 0, "hit_rate": None}
+
+
 def test_twin_counts_disconnects_and_truncates_their_latency():
     out = _twin().run(tr.generate("disconnect_storm", 6, n=60, rps=30.0))
     assert out["disconnected"] > 0
@@ -426,6 +458,20 @@ def test_real_replica_kill_midsoak_scenario(rig):
     assert res["chaos"] and "kill_tick" in res["chaos"]
     assert res["summary"]["hung"] == 0
     assert res["metrics"]["kv_pages_leaked"] == 0
+
+
+@pytest.mark.slow
+def test_real_prefix_storm_scenario():
+    # own rig: prefix_storm needs prefix_cache + spill overrides the
+    # shared fixture rig does not carry
+    from polyaxon_tpu.scenarios.registry import SCENARIOS, run_real
+
+    res = run_real(SCENARIOS["prefix_storm"], smoke=True)
+    assert res["pass"], res["assertions"]
+    assert res["summary"]["hung"] == 0
+    # warm pages are NOT leaks: the prefix_held gauge discounts them
+    assert res["metrics"]["kv_pages_leaked"] == 0
+    assert res["metrics"]["prefix_hit_rate"] >= 0.25
 
 
 @pytest.mark.slow

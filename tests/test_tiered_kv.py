@@ -1,0 +1,388 @@
+"""ISSUE 17 crash honesty: the tiered KV spill must survive kills,
+corruption, and admission races without leaking pages, wedging a reader,
+or ever serving wrong bytes.
+
+Four layers:
+
+  * SpillManager units — RAM/disk round-trips are byte-identical, RAM
+    overflow demotes to CRC-framed disk segments, and the heal pass
+    honors the eventlog crash contract: torn tails truncate, incomplete
+    segments delete (ignorable), corrupt segments quarantine to
+    `<seg>.corrupt` (clean miss, never a wedge);
+  * chaos at `kv.spill` — a kill after the meta frame leaves an
+    ignorable segment; a kill after the payload frames leaves a
+    COMPLETE, restorable one; a scrambled tail heals back to the last
+    whole frame. Mid-spill death is always restorable-or-ignorable.
+  * chaos at `kv.restore` + the lost-admission race — a kill mid-restore
+    and an insert that loses a forced hash collision must both return
+    every page the restore held: zero leaked pages, zero stuck
+    reservations, no pending device writes.
+  * live HTTP — a prefix evicted to the spill tier and hit again decodes
+    byte-identically (restore, not re-prefill), and a warm request
+    re-routed to a different replica still decodes byte-identically
+    (affinity is a placement hint, never a correctness input).
+"""
+
+import hashlib
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.chaos.injector import (
+    SimulatedKill,
+    active,
+    corrupt_segment_frame,
+)
+from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+from polyaxon_tpu.models.kv_pages import page_hashes
+from polyaxon_tpu.serving.spill import SpillManager, SpillPayload
+
+pytestmark = pytest.mark.serving
+
+PT = 8  # page_tokens used throughout
+
+
+# ---------------------------------------------------- payload helpers
+def _payload(n_pages=2, seed=0, first_token=1):
+    """A synthetic spilled entry: n_pages full pages of tokens and two
+    KV leaves of random bytes per page."""
+    rng = np.random.RandomState(seed)
+    tokens = tuple(range(first_token, first_token + n_pages * PT))
+    hashes = tuple(page_hashes(tokens, PT))
+    pages = [
+        [rng.randn(PT, 2, 4).astype(np.float32) for _ in range(2)]
+        for _ in range(n_pages)
+    ]
+    return SpillPayload(tokens, hashes, pages)
+
+
+def _same_bytes(a: SpillPayload, b: SpillPayload) -> bool:
+    if a.tokens != b.tokens or a.hashes != b.hashes:
+        return False
+    if len(a.pages) != len(b.pages):
+        return False
+    return all(
+        np.array_equal(x, y)
+        for pa, pb in zip(a.pages, b.pages)
+        for x, y in zip(pa, pb)
+    )
+
+
+# ---------------------------------------------------- SpillManager units
+def test_ram_roundtrip_byte_identical():
+    sm = SpillManager(ram_bytes=1 << 20)
+    p = _payload()
+    assert sm.put(p)
+    h = p.hashes[-1]
+    assert h in sm.heads()
+    assert sm.has(h, p.tokens)
+    # verified content: a forced collision (same head, other tokens)
+    # reads as a miss, exactly like PrefixCache
+    assert not sm.has(h, tuple(t + 1 for t in p.tokens))
+    got = sm.take(h, p.tokens)
+    assert got is not None and _same_bytes(p, got)
+    assert not sm.has(h, p.tokens) and sm.restored_ram == 1
+
+
+def test_ram_overflow_demotes_to_disk_and_restores(tmp_path):
+    p1, p2 = _payload(seed=1, first_token=1), _payload(seed=2, first_token=1000)
+    sm = SpillManager(ram_bytes=p1.nbytes + 1, dir_path=str(tmp_path))
+    assert sm.put(p1) and sm.put(p2)
+    # LRU (p1) demoted to a CRC-framed segment, p2 stayed resident
+    assert sm.ram_entries == 1 and sm.disk_entries == 1
+    segs = list(tmp_path.glob("*.seg"))
+    assert len(segs) == 1
+    got = sm.take(p1.hashes[-1], p1.tokens)
+    assert got is not None and _same_bytes(p1, got)
+    assert sm.restored_disk == 1
+    # the consumed segment is gone from disk too
+    assert not list(tmp_path.glob("*.seg"))
+
+
+def test_disk_budget_drops_oldest(tmp_path):
+    p1, p2 = _payload(seed=1, first_token=1), _payload(seed=2, first_token=1000)
+    sm = SpillManager(dir_path=str(tmp_path), dir_bytes=p1.nbytes + 1)
+    assert sm.put(p1) and sm.put(p2)
+    assert sm.disk_entries == 1 and sm.dropped == 1
+    assert not sm.has(p1.hashes[-1], p1.tokens)
+    assert sm.has(p2.hashes[-1], p2.tokens)
+
+
+def test_heal_truncates_torn_tail(tmp_path):
+    p = _payload(seed=3)
+    sm = SpillManager(dir_path=str(tmp_path))
+    assert sm.put(p)
+    (seg,) = tmp_path.glob("*.seg")
+    # the torn tail a power cut leaves: garbage after the last whole frame
+    with open(seg, "ab") as f:
+        f.write(b"\x7fgarbage-torn-tail")
+    sm2 = SpillManager(dir_path=str(tmp_path))
+    assert sm2.has(p.hashes[-1], p.tokens)
+    got = sm2.take(p.hashes[-1], p.tokens)
+    assert got is not None and _same_bytes(p, got)
+
+
+def test_corrupt_segment_quarantines_clean_miss(tmp_path):
+    p = _payload(seed=4)
+    sm = SpillManager(dir_path=str(tmp_path))
+    assert sm.put(p)
+    (seg,) = tmp_path.glob("*.seg")
+    corrupt_segment_frame(str(seg))
+    sm2 = SpillManager(dir_path=str(tmp_path))
+    # bit rot reads as a clean miss, never a wedge or wrong KV
+    assert sm2.quarantined == 1
+    assert not sm2.has(p.hashes[-1], p.tokens)
+    assert list(tmp_path.glob("*.seg.corrupt")) and not list(
+        tmp_path.glob("*.seg")
+    )
+    # the quarantined file is inert: a THIRD heal pass ignores it
+    sm3 = SpillManager(dir_path=str(tmp_path))
+    assert sm3.quarantined == 0 and sm3.disk_entries == 0
+    # and the directory stays writable after quarantine
+    assert sm3.put(p) and sm3.has(p.hashes[-1], p.tokens)
+
+
+def test_kill_after_meta_frame_is_ignorable(tmp_path):
+    p = _payload(seed=5)
+    sm = SpillManager(dir_path=str(tmp_path))
+    plan = FaultPlan([Fault(point="kv.spill", action="kill", at=0)])
+    with active(plan), pytest.raises(SimulatedKill):
+        sm.put(p)  # died after the meta frame, before any payload frame
+    sm2 = SpillManager(dir_path=str(tmp_path))
+    # meta-only segment: incomplete, deleted, a clean miss — never torn
+    assert sm2.incomplete >= 1 and sm2.disk_entries == 0
+    assert not sm2.has(p.hashes[-1], p.tokens)
+    assert sm2.put(p)  # directory still fully usable
+
+
+def test_kill_after_payload_frames_is_restorable(tmp_path):
+    p = _payload(seed=6)
+    sm = SpillManager(dir_path=str(tmp_path))
+    # at=1: the second kv.spill hit — every frame flushed, index not yet
+    plan = FaultPlan([Fault(point="kv.spill", action="kill", at=1)])
+    with active(plan), pytest.raises(SimulatedKill):
+        sm.put(p)
+    sm2 = SpillManager(dir_path=str(tmp_path))
+    got = sm2.take(p.hashes[-1], p.tokens)
+    assert got is not None and _same_bytes(p, got)
+
+
+def test_scrambled_tail_mid_spill_heals_restorable(tmp_path):
+    p = _payload(seed=7)
+    sm = SpillManager(dir_path=str(tmp_path))
+    plan = FaultPlan(
+        [Fault(point="kv.spill", action="scramble_tail", at=1)], seed=11
+    )
+    with active(plan), pytest.raises(SimulatedKill):
+        sm.put(p)
+    sm2 = SpillManager(dir_path=str(tmp_path))
+    got = sm2.take(p.hashes[-1], p.tokens)
+    assert got is not None and _same_bytes(p, got)
+
+
+# ------------------------------------------- KVCacheManager restore races
+CFG = {
+    "preset": "tiny", "seq_len": 64, "n_layers": 2, "dim": 64,
+    "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128,
+}
+LADDERS = ((32,), (8,))
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import build_model
+
+    b = build_model("transformer_lm", CFG)
+    params = b.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )["params"]
+    return b.module, params
+
+
+def _collide_hash(prev, chunk):
+    # token ids 100 apart hash identically — a forced chain collision
+    canon = tuple(int(t) % 100 for t in chunk)
+    return hashlib.blake2b(
+        repr((prev, canon)).encode(), digest_size=16
+    ).hexdigest()
+
+
+def _manager(model, **kw):
+    from polyaxon_tpu.serving.kv import KVCacheManager
+
+    module, params = model
+    return KVCacheManager(
+        module, params, pool_pages=16, page_tokens=PT,
+        spill_ram_bytes=1 << 20, **kw,
+    )
+
+
+def _spill_payload_for(mgr, tokens):
+    """A restorable spill entry whose per-page leaf shapes match the
+    manager's cache leaves (page-sliced), so a queued restore could
+    actually flush."""
+    import jax
+
+    hashes = tuple(page_hashes(tokens, PT, mgr.prefix.hash_fn))
+    scanned = bool(getattr(mgr.module.cfg, "scan_layers", False))
+    shapes = [
+        (tuple(leaf.shape[0:1]) + tuple(leaf.shape[2:]))
+        if scanned else tuple(leaf.shape[1:])
+        for leaf in jax.tree.leaves(mgr.cache)
+    ]
+    pages = [
+        [np.zeros(s, np.float32) for s in shapes]
+        for _ in range(len(tokens) // PT)
+    ]
+    return SpillPayload(tuple(tokens), hashes, pages)
+
+
+def test_kill_mid_restore_leaks_zero_pages(model):
+    mgr = _manager(model)
+    prompt = tuple(range(1, 17))  # two full pages
+    mgr._spill.put(_spill_payload_for(mgr, prompt))
+    used0, reserved0 = mgr.pool.used, mgr.pool.reserved
+    plan = FaultPlan([Fault(point="kv.restore", action="kill", at=0)])
+    with active(plan), pytest.raises(SimulatedKill):
+        mgr.plan_row(list(prompt) + [77], 4, *LADDERS, 64)
+    # the death mid-restore returned every page the restore held
+    assert mgr.pool.used == used0 and mgr.pool.reserved == reserved0
+    assert mgr.stats()["spill"]["pending_restores"] == 0
+    assert mgr.active_rows == 0
+    # and the manager still serves: the same row admits cleanly after
+    p = mgr.plan_row(list(prompt) + [77], 4, *LADDERS, 64)
+    mgr.release(p)
+    assert mgr.pool.used == used0 and mgr.pool.reserved == reserved0
+
+
+def test_lost_admission_race_aborts_without_leak(model):
+    mgr = _manager(model, hash_fn=_collide_hash)
+    # two token streams, same chain hashes (ids 100 apart): B occupies
+    # every chain slot in the live cache, A sits in the spill tier
+    a = tuple(range(1, 17))
+    b = (101,) + tuple(range(2, 17))
+    assert page_hashes(a, PT, _collide_hash) == page_hashes(b, PT, _collide_hash)
+    pages_b = mgr.pool.alloc(2)
+    assert mgr.prefix.insert(b[:PT], pages_b[:1])
+    assert mgr.prefix.insert(b, pages_b)
+    mgr.pool.unref(pages_b)  # entries hold their own refs now
+    mgr._spill.put(_spill_payload_for(mgr, a))
+    used0, reserved0 = mgr.pool.used, mgr.pool.reserved
+    # admitting A finds its spilled prefix, restores, then loses every
+    # insert to B's occupied slots — the restore must cancel cleanly
+    p = mgr.plan_row(list(a) + [77], 4, *LADDERS, 64)
+    assert mgr.restore_aborted == 1
+    assert mgr.stats()["spill"]["pending_restores"] == 0
+    # A got no prefix (collision reads as a miss, first writer wins)
+    assert p.prefix_len == 0 and p.prefix_entry is None
+    mgr.release(p)
+    assert mgr.pool.used == used0 and mgr.pool.reserved == reserved0
+
+
+# ------------------------------------------------------- live HTTP layer
+def _server(model, **overrides):
+    from polyaxon_tpu.serving.batching import ServingConfig
+    from polyaxon_tpu.serving.server import ModelServer
+
+    module, params = model
+    cfg = ServingConfig(**{
+        "max_batch": 4, "max_wait_ms": 2.0, "kv_pool_pages": 24,
+        "kv_page_tokens": PT, "spill_ram_bytes": 32 << 20, **overrides,
+    })
+    return ModelServer(module, params, model_name="tiny", config=cfg)
+
+
+def _post(port, body, timeout=120):
+    import http.client
+
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("POST", "/generate", json.dumps(body))
+    r = c.getresponse()
+    out = r.read()
+    c.close()
+    return r.status, out
+
+
+def _stats(port):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statsz", timeout=60
+    ).read())
+
+
+def _greedy(tokens, seed=7):
+    return {
+        "tokens": [list(tokens)], "maxNewTokens": 6, "temperature": 0.0,
+        "seed": seed,
+    }
+
+
+def _prompts(n, plen=49, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 100, size=plen).tolist() for _ in range(n)]
+
+
+def test_http_evict_spill_restore_byte_identical(model):
+    srv = _server(model)
+    port = srv.start(port=0)
+    try:
+        target, *flood = _prompts(7)
+        s, cold = _post(port, _greedy(target))
+        assert s == 200, cold
+        # distinct prompts force harvest to demote the target's entries
+        # into the spill tier (pool: 24 pages, each prompt caches 6)
+        for f in flood:
+            s, _ = _post(port, _greedy(f))
+            assert s == 200
+        st = _stats(port)["kv"]["spill"]
+        assert st["spills"] >= 1, st
+        hits0 = _stats(port)["kv"]["prefix"]["hits"]
+        s, warm = _post(port, _greedy(target))
+        assert s == 200
+        st = _stats(port)["kv"]["spill"]
+        # the repeat rode a RESTORE (spill tier -> pool -> prefix hit),
+        # not a cold re-prefill — and decoded the exact same bytes
+        assert st["restores"] >= 1, st
+        assert _stats(port)["kv"]["prefix"]["hits"] > hits0
+        assert json.loads(cold)["tokens"] == json.loads(warm)["tokens"]
+    finally:
+        srv.stop()
+
+
+def test_http_reroute_warm_byte_identical(model):
+    from polyaxon_tpu.serving.router import Router
+
+    s1, s2 = _server(model), _server(model)
+    p1, p2 = s1.start(port=0), s2.start(port=0)
+    router = Router(
+        [f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}"],
+        poll_interval_s=60.0,
+    )
+    rport = router.start(port=0)
+    try:
+        target = _prompts(1, seed=9)[0]
+        s, cold = _post(rport, _greedy(target))
+        assert s == 200, cold
+        router.poll_once()  # pick up the holder's /kvz advertisement
+        s, warm = _post(rport, _greedy(target))
+        assert s == 200
+        # affinity steered the repeat to the replica that cached it
+        assert router.stats()["affinity"]["hits"] >= 1
+        assert json.loads(cold)["tokens"] == json.loads(warm)["tokens"]
+        # forced re-route: posting straight to EACH replica covers both
+        # the holder (warm) and the sibling (cold re-prefill) — placement
+        # is a latency hint, never a correctness input
+        for p in (p1, p2):
+            s, rerouted = _post(p, _greedy(target))
+            assert s == 200
+            assert json.loads(cold)["tokens"] == json.loads(rerouted)["tokens"]
+    finally:
+        router.stop()
+        s1.stop()
+        s2.stop()
