@@ -365,13 +365,30 @@ def stats(run_ref, n_spans, n_events, url, show_slo, n_traces):
 @click.option("--sort", default="recent", show_default=True,
               type=click.Choice(["recent", "slowest", "errors"]),
               help="list order (no TRACE_ID)")
-def trace(trace_id, url, n_traces, sort):
+@click.option("--export", "export_path", default=None,
+              type=click.Path(dir_okay=False, writable=True),
+              help="dump the ring's retained traces (full span "
+                   "timelines) as JSONL to this file for offline "
+                   "analysis, newest first")
+def trace(trace_id, url, n_traces, sort, export_path):
     """Inspect a serving request trace (GET /tracez).
 
     Without TRACE_ID, lists retained traces (tail-sampled: errors and
     the slowest requests are always kept). With a TRACE_ID — the value
-    of a response's X-Request-Id header — prints its span timeline."""
+    of a response's X-Request-Id header — prints its span timeline.
+    With --export FILE, every listed trace is fetched in full and
+    written as one JSON object per line."""
     url = url.rstrip("/")
+    if export_path:
+        listing = _http_json(f"{url}/tracez?n={n_traces}&sort={sort}")
+        count = 0
+        with open(export_path, "w") as f:
+            for t in listing.get("traces", []):
+                full = _http_json(f"{url}/tracez?id={t['id']}")
+                f.write(json.dumps(full, default=str) + "\n")
+                count += 1
+        click.echo(f"exported {count} traces to {export_path}")
+        return
     if not trace_id:
         _echo_trace_list(url, n_traces, sort)
         return
@@ -392,6 +409,172 @@ def trace(trace_id, url, n_traces, sort):
             f"{s.get('name', '?'):<14} "
             f"{s.get('dur_s', 0) * 1e3:9.3f} ms  {attrs}"
         )
+
+
+@cli.command()
+@click.argument("series", required=False)
+@click.option("--url", default="http://127.0.0.1:8601", show_default=True,
+              help="base URL of any /queryz surface (serving server, "
+                   "router, streams server)")
+@click.option("--since", default=None, type=float,
+              help="window start (server-clock seconds)")
+@click.option("--until", default=None, type=float,
+              help="window end (server-clock seconds)")
+@click.option("--last", default=None, type=float,
+              help="query the trailing N seconds (instead of --since)")
+@click.option("--step", default=None, type=float,
+              help="aggregation step, seconds (default: one window)")
+@click.option("--agg", default="avg", show_default=True,
+              type=click.Choice(
+                  ["avg", "min", "max", "rate", "p50", "p95", "p99"]
+              ))
+@click.option("--json", "as_json", is_flag=True,
+              help="print the raw /queryz payload")
+def query(series, url, since, until, last, step, agg, as_json):
+    """Query the metrics history of a live server (GET /queryz).
+
+    Without SERIES, lists what the server's history store holds. With
+    one, prints aggregated points over the window — `rate` is counter-
+    reset aware (a replica restart is annotated, never a negative
+    rate)."""
+    url = url.rstrip("/")
+    if not series:
+        data = _http_json(f"{url}/queryz")
+        click.echo(
+            f"history: {data.get('bytes', 0)} bytes, "
+            f"{len(data.get('series', []))} series"
+        )
+        for name in data.get("series", []):
+            click.echo(f"  {name}")
+        return
+    params = {"series": series, "agg": agg}
+    for k, v in (("since", since), ("until", until),
+                 ("last", last), ("step", step)):
+        if v is not None:
+            params[k] = v
+    from urllib.parse import urlencode
+
+    data = _http_json(f"{url}/queryz?{urlencode(params)}")
+    if as_json:
+        click.echo(json.dumps(data, indent=1, default=str))
+        return
+    click.echo(
+        f"{data['series']}  agg={data['agg']}  "
+        f"samples={data.get('samples', 0)}"
+        + (f"  resets={data['resets']}" if data.get("resets") else "")
+    )
+    for t, v in data.get("points", []):
+        click.echo(
+            f"  {t:14.3f}  " + ("-" if v is None else f"{v:.6g}")
+        )
+
+
+@cli.group()
+def perf():
+    """Performance history tools (metrics history + bench records)."""
+
+
+#: bench-record field → (history series, aggregation) used by
+#: `perf diff` when no explicit --map is given
+_PERF_DIFF_DEFAULT_MAP = {
+    # serving.ttft_ms is a histogram series: percentile aggs only
+    "ttft_ms": ("serving.ttft_ms", "p95"),
+}
+
+
+@perf.command("diff")
+@click.argument("bench_file", type=click.Path(exists=True, dir_okay=False))
+@click.option("--url", default="http://127.0.0.1:8601", show_default=True,
+              help="live /queryz surface to read the current window from")
+@click.option("--last", default=300.0, show_default=True, type=float,
+              help="live window length, seconds")
+@click.option("--map", "mappings", multiple=True,
+              help="bench_field=series[:agg] (repeatable; replaces the "
+                   "default ttft_ms=serving.ttft_ms:p95)")
+@click.option("--tolerance", default=None, type=float,
+              help="fail (exit 1) when live > bench*(1+TOLERANCE) on "
+                   "any compared field; omit for report-only")
+def perf_diff(bench_file, url, last, mappings, tolerance):
+    """Diff a live history window against a committed BENCH_*.json.
+
+    The bench record's tail JSONL is scanned for each mapped field
+    (last record carrying it wins), the live side is the /queryz
+    aggregate over the trailing --last seconds, and the drift is
+    printed per field. With --tolerance the command gates: any field
+    where live exceeds the bench value by more than the tolerance
+    fraction fails the diff (lower-is-better fields like latencies)."""
+    url = url.rstrip("/")
+    with open(bench_file) as f:
+        record = json.load(f)
+    bench: dict = {}
+    for line in (record.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            for k, v in rec.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    bench[k] = float(v)
+    fmap = dict(_PERF_DIFF_DEFAULT_MAP)
+    if mappings:
+        fmap = {}
+        for m in mappings:
+            field, _, target = m.partition("=")
+            if not target:
+                raise click.ClickException(
+                    f"--map wants bench_field=series[:agg], got {m!r}"
+                )
+            series, _, agg = target.partition(":")
+            fmap[field] = (series, agg or "avg")
+    from urllib.parse import urlencode
+
+    compared, failed = 0, []
+    for field, (series, agg) in sorted(fmap.items()):
+        if field not in bench:
+            click.echo(f"  {field:<16} not in bench record, skipped")
+            continue
+        q = urlencode(
+            {"series": series, "agg": agg, "last": last, "step": last}
+        )
+        data = _http_json(f"{url}/queryz?{q}")
+        live = next(
+            (v for _, v in reversed(data.get("points", []))
+             if v is not None),
+            None,
+        )
+        if live is None:
+            click.echo(
+                f"  {field:<16} bench={bench[field]:.4g}  live=EMPTY "
+                f"({series}:{agg} has no samples in the window)"
+            )
+            continue
+        compared += 1
+        drift = (live - bench[field]) / bench[field] if bench[field] else 0.0
+        worse = (
+            tolerance is not None
+            and live > bench[field] * (1.0 + tolerance)
+        )
+        click.echo(
+            f"  {field:<16} bench={bench[field]:.4g}  live={live:.4g}  "
+            f"drift={drift:+.1%}" + ("  REGRESSED" if worse else "")
+        )
+        if worse:
+            failed.append(field)
+    if not compared:
+        raise click.ClickException(
+            "nothing compared: no mapped field present in both the "
+            "bench record and the live history"
+        )
+    if failed:
+        raise click.ClickException(
+            f"perf diff failed tolerance {tolerance:+.0%}: "
+            + ", ".join(failed)
+        )
+    click.echo(f"compared {compared} field(s): ok")
 
 
 class _RunRefGroup(click.Group):

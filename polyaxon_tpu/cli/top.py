@@ -94,6 +94,61 @@ def _is_done(status) -> bool:
         return False
 
 
+#: default sparkline columns: (label, series, agg) queried off the
+#: router's federated /queryz (ISSUE 18); panes with no data are omitted
+SPARK_SERIES = (
+    ("req/s", "router.requests", "rate"),
+    ("p95 s", "router.request_seconds", "p95"),
+    ("queue", "router.replica_queue_depth.r0", "avg"),
+)
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list, width: int = 32) -> str:
+    """Scale a point list into block characters (None → space). Pure;
+    pinned directly by tests."""
+    vals = list(values)[-width:]
+    present = [v for v in vals if v is not None]
+    if not present:
+        return ""
+    lo, hi = min(present), max(present)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(" ")
+            continue
+        idx = (
+            int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+            if span > 0
+            else 0
+        )
+        out.append(_SPARK_BLOCKS[idx])
+    return "".join(out)
+
+
+def fetch_sparks(
+    url: str, *, last: float = 120.0, step: float = 5.0
+) -> Optional[list[tuple[str, list]]]:
+    """Pull the SPARK_SERIES windows off /queryz; None when the surface
+    has history disabled (503) or is unreachable — the pane disappears
+    rather than rendering empty."""
+    from urllib.parse import urlencode
+
+    out = []
+    for label, series, agg in SPARK_SERIES:
+        q = urlencode(
+            {"series": series, "agg": agg, "last": last, "step": step}
+        )
+        data = _fetch_json(f"{url}/queryz?{q}")
+        if data is None or "points" not in data:
+            continue
+        pts = [v for _, v in data["points"]]
+        if any(v is not None for v in pts):
+            out.append((label, pts))
+    return out or None
+
+
 def _fmt(v, width: int = 0, nd: int = 1) -> str:
     if v is None:
         s = "-"
@@ -113,6 +168,7 @@ def render_frame(
     runs: _RunTable,
     when: Optional[str] = None,
     max_runs: int = 10,
+    sparks: Optional[list[tuple[str, list]]] = None,
 ) -> str:
     """One dashboard frame as text (pure: all inputs passed in)."""
     lines: list[str] = []
@@ -169,6 +225,19 @@ def render_frame(
                     f"{_fmt(r.get('inflight'), 10)}"
                     f"{_fmt(r.get('requests'), 10)}"
                 )
+
+    if sparks:
+        # trend pane off the router's metrics history (/queryz): one
+        # sparkline per series, most recent point on the right
+        for i, (label, pts) in enumerate(sparks):
+            latest = next(
+                (v for v in reversed(pts) if v is not None), None
+            )
+            lines.append(
+                ("history  " if i == 0 else "         ")
+                + f"{label:<7} {sparkline(pts):<32}"
+                + f"  now {_fmt(latest, nd=3)}"
+            )
 
     if slo and slo.get("slos"):
         lines.append(
@@ -231,6 +300,7 @@ def run_top(
             slo=_fetch_json(url + "/sloz"),
             runs=runs,
             when=datetime.datetime.now().strftime("%H:%M:%S"),
+            sparks=fetch_sparks(url),
         )
         if once:
             out.write(frame + "\n")

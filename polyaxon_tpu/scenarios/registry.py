@@ -62,6 +62,14 @@ class Assertions:
     min_prefix_hit_rate: Optional[float] = None
     zero_hung: bool = True
     zero_leaked_pages: bool = True
+    # history predicates (ISSUE 18), evaluated over arrival-ordered
+    # per-request series ("latency_ms", "ttft_ms", "ok") built from the
+    # replay ledger (real) or the twin's TrendTapes — ONE schema for
+    # both modes. `max_metric_trend` bounds mean(second half) /
+    # mean(first half); `min_metric_floor` bounds the mean of EACH half
+    # from below (a floor that must hold across the whole story).
+    max_metric_trend: Optional[dict] = None
+    min_metric_floor: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +110,12 @@ _register(Scenario(
         # queue waits, not compile time
         max_shed_rate=0.2, p99_ms=30_000.0, max_error_rate=0.0,
         max_slo_burn=20.0, min_completed=8,
+        # history predicates (ISSUE 18): latency must not drift across
+        # the soak (the generous ratio absorbs the CI box's compile
+        # head landing in the FIRST half, which makes it look slow) and
+        # the completion rate must hold in BOTH halves
+        max_metric_trend={"latency_ms": 3.0},
+        min_metric_floor={"ok": 0.5},
     ),
 ))
 
@@ -188,6 +202,10 @@ _register(Scenario(
         # hit-rate gate is what must hold on the real stack
         max_shed_rate=0.2, max_error_rate=0.0, min_completed=8,
         min_prefix_hit_rate=0.25, ttft_p50_ms=30_000.0,
+        # warm cohort repeats must not make the tail of the storm
+        # slower than its head (prefix reuse should do the opposite)
+        max_metric_trend={"latency_ms": 3.0},
+        min_metric_floor={"ok": 0.5},
     ),
     twin_config=dict(prefix_cache=True, kv_pool_pages=64),
 ))
@@ -331,13 +349,56 @@ def _wait_drained(rig: Rig, budget_s: float = 20.0) -> list[str]:
 
 
 # ------------------------------------------------------------ evaluation
-def evaluate(a: Assertions, summary: dict, metrics: dict) -> list[dict]:
+def half_means(values) -> tuple[Optional[float], Optional[float]]:
+    """Mean of each half of an ordered value series (None, None when
+    fewer than 4 points — too thin for a trend verdict). Pure; the
+    history predicates in both real and twin modes ride this."""
+    vals = [float(v) for v in values if v is not None]
+    if len(vals) < 4:
+        return None, None
+    mid = len(vals) // 2
+    return sum(vals[:mid]) / mid, sum(vals[mid:]) / (len(vals) - mid)
+
+
+def evaluate(a: Assertions, summary: dict, metrics: dict,
+             history: Optional[dict] = None) -> list[dict]:
     """Assertion verdicts for one run; identical schema for real and
-    twin modes so calibration can diff them."""
+    twin modes so calibration can diff them. `history` maps series
+    name → arrival-ordered values for the ISSUE 18 trend/floor
+    predicates."""
     out = []
 
     def check(name: str, ok: bool, detail: str) -> None:
         out.append({"assertion": name, "ok": bool(ok), "detail": detail})
+
+    for series, max_ratio in sorted((a.max_metric_trend or {}).items()):
+        first, second = half_means((history or {}).get(series, ()))
+        if first is None:
+            # too thin to call a drift — vacuous, but say so
+            check(f"max_metric_trend:{series}", True,
+                  f"insufficient samples for {series!r}, trend vacuous")
+            continue
+        ratio = (second / first) if first > 0 else None
+        check(
+            f"max_metric_trend:{series}",
+            ratio is None or ratio <= max_ratio,
+            f"trend={None if ratio is None else round(ratio, 4)} "
+            f"<= {max_ratio} (halves {round(first, 3)} -> "
+            f"{round(second, 3)})",
+        )
+    for series, floor in sorted((a.min_metric_floor or {}).items()):
+        first, second = half_means((history or {}).get(series, ()))
+        if first is None:
+            check(f"min_metric_floor:{series}", False,
+                  f"no samples for floor on {series!r}")
+            continue
+        low = min(first, second)
+        check(
+            f"min_metric_floor:{series}",
+            low >= floor,
+            f"floor={round(low, 4)} >= {floor} (halves "
+            f"{round(first, 4)} / {round(second, 4)})",
+        )
 
     if a.zero_hung:
         check("zero_hung", summary["hung"] == 0,
@@ -444,7 +505,8 @@ def run_twin(scn: Scenario, *, smoke: bool = False,
         "kv_pages_leaked": summary["kv_pages_leaked"],
         "prefix_hit_rate": summary.get("prefix", {}).get("hit_rate"),
     }
-    verdicts = evaluate(scn.assertions, summary, metrics)
+    history = {k: list(t.points) for k, t in twin.tapes.items()}
+    verdicts = evaluate(scn.assertions, summary, metrics, history)
     return {
         "scenario": scn.name,
         "mode": "twin",
@@ -537,7 +599,23 @@ def run_real(scn: Scenario, *, smoke: bool = False,
                 if live_texts else None
             ),
         }
-        verdicts = evaluate(scn.assertions, summary, metrics)
+        # the same history series the twin tapes, rebuilt off the
+        # replay ledger in arrival order (ISSUE 18)
+        outs = sorted(report.outcomes, key=lambda o: o.i)
+        history = {
+            "latency_ms": [
+                o.latency_ms for o in outs
+                if o.status == 200 and o.latency_ms is not None
+            ],
+            "ttft_ms": [
+                o.ttft_ms for o in outs if o.ttft_ms is not None
+            ],
+            "ok": [
+                1.0 if (o.status == 200 or o.disconnected) else 0.0
+                for o in outs
+            ],
+        }
+        verdicts = evaluate(scn.assertions, summary, metrics, history)
         return {
             "scenario": scn.name,
             "mode": "real",

@@ -153,6 +153,28 @@ class _Row:
         self.prefix_group = rec.prefix_group
 
 
+class TrendTape:
+    """Order-preserving, bounded per-request value tape feeding the
+    history assertion predicates (`max_metric_trend`/`min_metric_floor`,
+    ISSUE 18). When full, every other point is dropped and the sampling
+    stride doubles — halves stay halves at million-request scale while
+    memory stays O(cap)."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = max(8, int(cap))
+        self.stride = 1
+        self._i = 0
+        self.points: list[float] = []
+
+    def add(self, v: float) -> None:
+        if self._i % self.stride == 0:
+            self.points.append(float(v))
+            if len(self.points) >= self.cap:
+                self.points = self.points[::2]
+                self.stride *= 2
+        self._i += 1
+
+
 class _Replica:
     __slots__ = ("up", "queue", "batch", "pages_used", "prefix_groups")
 
@@ -207,6 +229,13 @@ class ServingTwin:
         # prefix-directory ledger (ISSUE 17)
         self.prefix_lookups = 0
         self.prefix_hits = 0
+        # arrival-ordered value tapes for the history predicates
+        # (ISSUE 18): same series names run_real builds off the ledger
+        self.tapes = {
+            "latency_ms": TrendTape(),
+            "ttft_ms": TrendTape(),
+            "ok": TrendTape(),
+        }
 
     # ------------------------------------------------------------ events
     def _push(self, t: float, kind: str, data) -> None:
@@ -257,6 +286,7 @@ class ServingTwin:
             return
         self.counts["shed"] += 1
         self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        self.tapes["ok"].add(0.0)
         self.resolved += 1
 
     def _requeue(self, row: _Row, now: float) -> None:
@@ -354,6 +384,9 @@ class ServingTwin:
 
     def _observe(self, latency_s: float, ttft_ms: float) -> None:
         lat_ms = latency_s * 1e3
+        self.tapes["latency_ms"].add(lat_ms)
+        self.tapes["ttft_ms"].add(ttft_ms)
+        self.tapes["ok"].add(1.0)
         self._lat_sum += lat_ms
         self._lat_n += 1
         for res, v in ((self._lat_res, lat_ms), (self._ttft_res, ttft_ms)):

@@ -21,7 +21,7 @@ from typing import Annotated, Any, Literal, Optional, Union
 
 from pydantic import Field, model_validator
 
-from .base import BaseSchema
+from .base import BaseSchema, to_camel
 from .environment import V1Environment
 
 
@@ -489,6 +489,97 @@ class V1SLOSpec(BaseSchema):
         return out
 
 
+class V1HistorySpec(BaseSchema):
+    """Metrics-history store knobs (telemetry/history.py). When enabled,
+    the serving layer samples its registry into CRC-framed tiered
+    segments under `<outputs>/telemetry/history/` and serves `/queryz`
+    rate/trend queries over them."""
+
+    enabled: bool = True
+    # sampler cadence, seconds
+    interval_s: float | str = 1.0
+    # total retention budget across all tiers, bytes
+    max_bytes: Optional[int | str] = None
+    # segment rotation size, bytes
+    segment_bytes: Optional[int | str] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        if (
+            isinstance(self.interval_s, (int, float))
+            and self.interval_s <= 0
+        ):
+            raise ValueError(
+                f"history.intervalS must be > 0, got {self.interval_s}"
+            )
+        for field in ("max_bytes", "segment_bytes"):
+            v = getattr(self, field)
+            if isinstance(v, int) and v <= 0:
+                raise ValueError(
+                    f"history.{to_camel(field)} must be > 0, got {v}"
+                )
+        return self
+
+    def to_config(self, history_dir: str) -> dict:
+        """The dict ModelServer's `history=` ctor arg consumes; the
+        store location is the caller's (it knows the run's outputs)."""
+        out = {"dir": history_dir, "interval_s": float(self.interval_s)}
+        if self.max_bytes is not None:
+            out["max_bytes"] = int(self.max_bytes)
+        if self.segment_bytes is not None:
+            out["segment_bytes"] = int(self.segment_bytes)
+        return out
+
+
+class V1RegressionRuleSpec(BaseSchema):
+    """One declarative perf-regression rule evaluated by the sentinel
+    (telemetry/detect.py) over metrics-history windows."""
+
+    name: str
+    # a history series name, e.g. serving.ttft_ms
+    series: str
+    kind: Literal["ceiling", "window_ratio", "ewma_drift"] = "ceiling"
+    agg: Literal["avg", "min", "max", "rate", "p50", "p95", "p99"] = "avg"
+    window_s: float | str = 60.0
+    threshold: float | str
+    direction: Literal["above", "below"] = "above"
+    # ewma_drift only: smoothing factor and baseline depth
+    alpha: float | str = 0.3
+    lookback_windows: int | str = 5
+    min_samples: int | str = 3
+
+    @model_validator(mode="after")
+    def _check(self):
+        if isinstance(self.window_s, (int, float)) and self.window_s <= 0:
+            raise ValueError(
+                f"rule {self.name!r}: windowS must be > 0, "
+                f"got {self.window_s}"
+            )
+        if isinstance(self.alpha, (int, float)) and not (
+            0.0 < self.alpha <= 1.0
+        ):
+            raise ValueError(
+                f"rule {self.name!r}: alpha must be in (0, 1], "
+                f"got {self.alpha}"
+            )
+        return self
+
+    def to_config(self) -> dict:
+        """The normalized dict telemetry.detect.build_rules consumes."""
+        return {
+            "name": self.name,
+            "series": self.series,
+            "kind": self.kind,
+            "agg": self.agg,
+            "window_s": float(self.window_s),
+            "threshold": float(self.threshold),
+            "direction": self.direction,
+            "alpha": float(self.alpha),
+            "lookback_windows": int(self.lookback_windows),
+            "min_samples": int(self.min_samples),
+        }
+
+
 class V1ObservabilitySpec(BaseSchema):
     """Telemetry knobs (polyaxon_tpu/telemetry/) a run can pin in its
     spec. Presence of the section also opts the run into host/HBM
@@ -505,6 +596,12 @@ class V1ObservabilitySpec(BaseSchema):
     # serving SLOs: enables the burn-rate engine + breach flight recorder
     # when this run's checkpoint is served (serving/server.py from_run)
     slos: Optional[list[V1SLOSpec]] = None
+    # metrics history (ISSUE 18): sampler + /queryz when served
+    history: Optional[V1HistorySpec] = None
+    # perf-regression sentinel rules over history windows; the string
+    # "default" arms the serving drift pack (telemetry.detect.
+    # DEFAULT_SERVING_RULES). Requires `history`.
+    regression_rules: Optional[list[V1RegressionRuleSpec] | str] = None
 
     @model_validator(mode="after")
     def _check(self):
@@ -523,7 +620,37 @@ class V1ObservabilitySpec(BaseSchema):
                 "histogramBuckets must be a strictly ascending list of "
                 f"positive numbers, got {b}"
             )
+        if isinstance(self.regression_rules, str):
+            if self.regression_rules != "default":
+                raise ValueError(
+                    "regressionRules must be a rule list or the string "
+                    f"'default', got {self.regression_rules!r}"
+                )
+        if self.regression_rules is not None and (
+            self.history is None or not self.history.enabled
+        ):
+            raise ValueError(
+                "regressionRules require observability.history (the "
+                "sentinel evaluates rules over the history store)"
+            )
+        if isinstance(self.regression_rules, list):
+            names = [r.name for r in self.regression_rules]
+            if len(names) != len(set(names)):
+                raise ValueError(
+                    f"duplicate regression rule names in {names}"
+                )
         return self
+
+    def rules_config(self) -> Optional[list[dict]]:
+        """The normalized rule dicts telemetry.detect.build_rules
+        consumes; resolves the "default" pack."""
+        if self.regression_rules is None:
+            return None
+        if isinstance(self.regression_rules, str):
+            from ..telemetry.detect import DEFAULT_SERVING_RULES
+
+            return [dict(r) for r in DEFAULT_SERVING_RULES]
+        return [r.to_config() for r in self.regression_rules]
 
 
 class V1Program(BaseSchema):

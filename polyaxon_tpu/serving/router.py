@@ -70,12 +70,15 @@ from urllib import error as urlerror
 from urllib import request as urlrequest
 
 from ..telemetry import (
+    HistoryStore,
     MetricsRegistry,
     RequestTrace,
     TraceRing,
     new_trace_id,
     now as _now,
+    queryz_payload,
 )
+from ..telemetry.history import sample_from_snapshots, sample_registry
 from ..telemetry.federate import (
     PromSnapshot,
     federate,
@@ -229,6 +232,7 @@ class Router:
         federate: bool = True,
         affinity: bool = True,
         affinity_imbalance: float = 4.0,
+        history: Optional[dict] = None,
     ):
         self._provider: Callable[[], Sequence[str]] = (
             endpoints if callable(endpoints) else (lambda: endpoints)
@@ -321,6 +325,34 @@ class Router:
                 ],
                 self.telemetry,
                 on_breach=self._scale_up,
+            )
+        # FEDERATED metrics history (ISSUE 18): one store on the router
+        # holds every replica's series (`<name>{replica="rN"}`) plus
+        # `cluster:*:sum` rollups plus the router's own registry — the
+        # poll loop appends one sample per pass, so history cadence rides
+        # poll_interval_s, and /queryz answers fleet-wide trend queries.
+        # `history` is a V1HistorySpec.to_config()-shaped dict.
+        self.history: Optional[HistoryStore] = None
+        self._m_history_samples = None
+        self._m_history_bytes = None
+        if history is not None and history.get("dir"):
+            self.history = HistoryStore(
+                history["dir"],
+                max_bytes=int(
+                    history.get("max_bytes") or HistoryStore.DEFAULT_MAX_BYTES
+                ),
+                segment_bytes=int(
+                    history.get("segment_bytes")
+                    or HistoryStore.DEFAULT_SEGMENT_BYTES
+                ),
+            )
+            self._m_history_samples = self.telemetry.counter(
+                "history.samples",
+                help="Federated history samples committed to the store",
+            )
+            self._m_history_bytes = self.telemetry.gauge(
+                "history.bytes",
+                help="Total bytes across history segments (all tiers)",
             )
         self.refresh()
 
@@ -446,6 +478,27 @@ class Router:
             sum(1 for s in self.states() if s.routable)
         )
         self._autoscale_tick()
+        self._record_history()
+
+    def _record_history(self) -> None:
+        """Append one federated sample: the router's own registry merged
+        with every replica's `replica=`-labeled series and `cluster:*`
+        rollups (built from the poll pass's parsed snapshots — no extra
+        scrape). Advisory: a full disk must never kill the poll loop."""
+        if self.history is None:
+            return
+        t = _now()
+        try:
+            rec = sample_registry(self.telemetry, t)
+            fed = sample_from_snapshots(
+                [(s.slug, s.metrics_snap) for s in self.states()], t
+            )
+            rec["s"].update(fed["s"])
+            self.history.append(rec)
+            self._m_history_samples.inc()
+            self._m_history_bytes.set(float(self.history.total_bytes()))
+        except Exception:
+            pass
 
     def _poll_loop(self) -> None:
         while not self._stop_poll.wait(self.poll_interval_s):
@@ -1121,6 +1174,11 @@ class Router:
                         if router.slo_engine is not None
                         else {"enabled": False, "breached": False, "slos": []},
                     )
+                elif path == "/queryz":
+                    # fleet-wide trend queries over the FEDERATED history
+                    # the poll loop records (ISSUE 18)
+                    code, payload = queryz_payload(router.history, _query)
+                    self._send(code, payload)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

@@ -74,14 +74,20 @@ from typing import Optional
 from ..chaos.injector import inject
 from ..store.local import RunStore
 from ..telemetry import (
+    DEFAULT_SERVING_RULES,
     FlightRecorder,
+    HistorySampler,
+    HistoryStore,
     MetricsRegistry,
+    RegressionSentinel,
     RequestTrace,
     SLOEngine,
     TraceRing,
     build_objectives,
+    build_rules,
     new_trace_id,
     now as _now,
+    queryz_payload,
 )
 from .batching import (
     CircuitBreaker,
@@ -190,6 +196,9 @@ class ModelServer:
         slo_profile_s: float = 0.0,
         sharding_rules: tuple = (),
         mesh=None,
+        history: Optional[dict] = None,
+        regression_rules: Optional[list] = None,
+        event_sink=None,
     ):
         self.config = config or ServingConfig()
         # the run-spec path validates these combos in V1ServingSpec, but
@@ -503,16 +512,18 @@ class ModelServer:
         # edge dumps a post-mortem bundle under <debug_dir>/
         self.slo_engine: Optional[SLOEngine] = None
         self.flight_recorder: Optional[FlightRecorder] = None
+        # the recorder serves both breach sources: SLO burn edges and the
+        # ISSUE 18 regression sentinel's perf_regression edges
+        if debug_dir is not None and (slos or regression_rules):
+            self.flight_recorder = FlightRecorder(
+                debug_dir,
+                registry=self.telemetry,
+                trace_ring=self.traces,
+                state_fn=self._occupancy_state,
+                trace_fn=self._breach_trace,
+                profile_s=slo_profile_s,
+            )
         if slos:
-            if debug_dir is not None:
-                self.flight_recorder = FlightRecorder(
-                    debug_dir,
-                    registry=self.telemetry,
-                    trace_ring=self.traces,
-                    state_fn=self._occupancy_state,
-                    trace_fn=self._breach_trace,
-                    profile_s=slo_profile_s,
-                )
             self.slo_engine = SLOEngine(
                 build_objectives(
                     slos,
@@ -526,6 +537,41 @@ class ModelServer:
                     if self.flight_recorder is not None
                     else None
                 ),
+            )
+        # metrics history + regression sentinel (ISSUE 18): a background
+        # sampler snapshots THIS registry into a crash-consistent tiered
+        # store under <outputs>/telemetry/history/, /queryz reads it, and
+        # declarative rules over its windows fire edge-triggered
+        # perf_regression events (event_sink → run event log) plus
+        # flight-recorder bundles. `history` is a dict shaped like
+        # V1HistorySpec.to_config(): dir (required), interval_s,
+        # max_bytes, segment_bytes.
+        self.history: Optional[HistoryStore] = None
+        self.history_sampler: Optional[HistorySampler] = None
+        self.sentinel: Optional[RegressionSentinel] = None
+        if history is not None and history.get("dir"):
+            self.history = HistoryStore(
+                history["dir"],
+                max_bytes=int(
+                    history.get("max_bytes") or HistoryStore.DEFAULT_MAX_BYTES
+                ),
+                segment_bytes=int(
+                    history.get("segment_bytes")
+                    or HistoryStore.DEFAULT_SEGMENT_BYTES
+                ),
+            )
+            self.history_sampler = HistorySampler(
+                self.telemetry,
+                self.history,
+                interval_s=float(history.get("interval_s") or 1.0),
+            )
+        if regression_rules and self.history is not None:
+            self.sentinel = RegressionSentinel(
+                self.history,
+                self.telemetry,
+                build_rules(regression_rules),
+                on_event=event_sink,
+                recorder=self.flight_recorder,
             )
         self._prompt_ladder, self._new_ladder = self.config.ladders(
             int(module.cfg.seq_len)
@@ -935,11 +981,23 @@ class ModelServer:
         )
         params, step = _restore_params_subtree(str(ckpt_dir), abstract)
         # the run's own SLOs (spec observability.slos) arm the burn-rate
-        # engine; breach bundles land next to the checkpoints it serves
+        # engine; breach bundles land next to the checkpoints it serves.
+        # observability.history arms the metrics-history sampler under
+        # <outputs>/telemetry/history/ and observability.regressionRules
+        # the sentinel — whose perf_regression edges land in THIS run's
+        # event log (ISSUE 18)
         slos = None
+        history = None
+        rules = None
         obs = program.observability
         if obs is not None and obs.slos:
             slos = [s.to_config() for s in obs.slos]
+        if obs is not None and obs.history is not None and obs.history.enabled:
+            history = obs.history.to_config(
+                str(store.outputs_dir(uuid) / "telemetry" / "history")
+            )
+        if obs is not None and obs.regression_rules:
+            rules = obs.rules_config()
         return cls(
             bundle.module,
             params,
@@ -949,10 +1007,19 @@ class ModelServer:
             expected_devices=expected_devices,
             slos=slos,
             debug_dir=(
-                str(store.outputs_dir(uuid) / "debug") if slos else None
+                str(store.outputs_dir(uuid) / "debug")
+                if (slos or rules)
+                else None
             ),
             sharding_rules=bundle.sharding_rules,
             mesh=mesh,
+            history=history,
+            regression_rules=rules,
+            event_sink=(
+                (lambda kind, body: store.log_event(uuid, kind, body))
+                if rules
+                else None
+            ),
         )
 
     # --------------------------------------------------------- validation
@@ -2451,6 +2518,11 @@ class ModelServer:
                         if server.slo_engine is not None
                         else {"enabled": False, "breached": False, "slos": []},
                     )
+                elif path == "/queryz":
+                    # metrics history (ISSUE 18): rate/trend queries over
+                    # the sampler's tiered store; 503 when history is off
+                    code, payload = queryz_payload(server.history, query)
+                    self._send(code, payload)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
@@ -2580,6 +2652,10 @@ class ModelServer:
         self._m_ready.set(1)
         if self.slo_engine is not None:
             self.slo_engine.start()
+        if self.history_sampler is not None:
+            self.history_sampler.start()
+        if self.sentinel is not None:
+            self.sentinel.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -2604,6 +2680,10 @@ class ModelServer:
         self._m_ready.set(0)
         if self.slo_engine is not None:
             self.slo_engine.stop()
+        if self.sentinel is not None:
+            self.sentinel.stop()
+        if self.history_sampler is not None:
+            self.history_sampler.stop()
         if self._coalescer is not None:
             self._coalescer.stop(drain_s=grace)
             # a restarted server gets a fresh worker (and breaker)

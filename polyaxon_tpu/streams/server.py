@@ -73,6 +73,9 @@ class _Handler(BaseHTTPRequestHandler):
     #: optional {slug: base_url} of sibling registries (agents, trainers)
     #: whose /metricsz this server federates; injected by make_server
     federate_sources: dict[str, str] = {}
+    #: optional metrics-history store behind /queryz (ISSUE 18);
+    #: injected by make_server when history_dir is set
+    history = None
 
     def log_message(self, *args):  # quiet
         pass
@@ -131,6 +134,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(
                     200, local.encode(), "text/plain; version=0.0.4"
                 )
+            if parts == ["queryz"]:
+                # rate/trend queries over the process registry's history
+                # (ISSUE 18); 503 with history disabled — same contract
+                # as the serving server and router
+                from ..telemetry import queryz_payload
+
+                code, payload = queryz_payload(self.history, parsed.query)
+                return self._send(code, _json_bytes(payload))
             if parts == ["openapi.json"]:
                 from .openapi import spec as openapi_spec
 
@@ -315,16 +326,38 @@ def make_server(
     host: str = "127.0.0.1",
     port: int = 8585,
     federate: Optional[dict[str, str]] = None,
+    history_dir: Optional[str] = None,
+    history_interval_s: float = 1.0,
 ) -> ThreadingHTTPServer:
+    # metrics history (ISSUE 18): with history_dir set, a background
+    # sampler snapshots the PROCESS registry (run-store transitions,
+    # retry/backoff, chaos counters) into the tiered store and /queryz
+    # serves trend queries over it. The sampler rides the server object
+    # so serve()/BackgroundServer own its lifecycle.
+    history = sampler = None
+    if history_dir:
+        from ..telemetry import (
+            HistorySampler,
+            HistoryStore,
+            get_registry,
+        )
+
+        history = HistoryStore(history_dir)
+        sampler = HistorySampler(
+            get_registry(), history, interval_s=history_interval_s
+        )
     handler = type(
         "BoundHandler",
         (_Handler,),
         {
             "store": store or RunStore(),
             "federate_sources": dict(federate or {}),
+            "history": history,
         },
     )
-    return ThreadingHTTPServer((host, port), handler)
+    server = ThreadingHTTPServer((host, port), handler)
+    server.history_sampler = sampler
+    return server
 
 
 def serve(
@@ -332,13 +365,21 @@ def serve(
     host: str = "127.0.0.1",
     port: int = 8585,
     federate: Optional[dict[str, str]] = None,
+    history_dir: Optional[str] = None,
 ):
-    server = make_server(store, host, port, federate=federate)
+    server = make_server(
+        store, host, port, federate=federate, history_dir=history_dir
+    )
     print(f"polyaxon streams serving on http://{host}:{port}")
+    if server.history_sampler is not None:
+        server.history_sampler.start()
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        if server.history_sampler is not None:
+            server.history_sampler.stop()
 
 
 class BackgroundServer:
@@ -348,16 +389,23 @@ class BackgroundServer:
         self,
         store: Optional[RunStore] = None,
         federate: Optional[dict[str, str]] = None,
+        history_dir: Optional[str] = None,
     ):
-        self.server = make_server(store, port=0, federate=federate)
+        self.server = make_server(
+            store, port=0, federate=federate, history_dir=history_dir
+        )
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(
             target=self.server.serve_forever, daemon=True
         )
 
     def __enter__(self):
+        if self.server.history_sampler is not None:
+            self.server.history_sampler.start()
         self._thread.start()
         return self
 
     def __exit__(self, *exc):
+        if self.server.history_sampler is not None:
+            self.server.history_sampler.stop()
         self.server.shutdown()

@@ -35,12 +35,23 @@ Import cost is stdlib-only — safe to import from anywhere in the
 package without cycles.
 """
 
+from .detect import (
+    DEFAULT_SERVING_RULES,
+    RegressionRule,
+    RegressionSentinel,
+    build_rules,
+)
 from .federate import (
     PromSample,
     PromSnapshot,
     federate,
     parse_prometheus_text,
     queue_wait_delta_ms,
+)
+from .history import (
+    HistorySampler,
+    HistoryStore,
+    queryz_payload,
 )
 from .registry import (
     Counter,
@@ -64,11 +75,16 @@ from .tracing import RequestTrace, TraceRing, new_trace_id, tracez_payload
 __all__ = [
     "AvailabilityObjective",
     "Counter",
+    "DEFAULT_SERVING_RULES",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "HistorySampler",
+    "HistoryStore",
     "LatencyObjective",
     "MetricsRegistry",
+    "RegressionRule",
+    "RegressionSentinel",
     "PromSample",
     "PromSnapshot",
     "RequestTrace",
@@ -76,12 +92,14 @@ __all__ = [
     "SpanTracer",
     "TraceRing",
     "build_objectives",
+    "build_rules",
     "federate",
     "get_registry",
     "get_tracer",
     "new_trace_id",
     "parse_prometheus_text",
     "queue_wait_delta_ms",
+    "queryz_payload",
     "tracez_payload",
     "mfu",
     "now",

@@ -252,6 +252,18 @@ def federate(
     (gauge-shaped series only — a max over counters is noise), grouped
     by the series' remaining labels so histogram buckets aggregate
     per-``le``.
+
+    Counter-reset hazard (ISSUE 18 audit): ``<prefix>:<name>:sum`` over
+    counter-shaped series is an *instantaneous* sum of cumulative
+    values. When one source restarts, its counters drop to zero and the
+    cluster sum DROPS — the aggregate is not itself a well-formed
+    monotone counter. Consumers must never difference two ``:sum``
+    readings naively; the history layer's ``rate_over``
+    (telemetry/history.py) treats any decrease as a reset (the
+    post-reset value is the increase) and annotates ``resets``, which is
+    why the router records ``cluster:*`` series into history rather than
+    rate-ing raw scrapes. Pinned by
+    ``test_history.py::test_federated_cluster_sum_reset_clamp``.
     """
     out: list[str] = []
     if local_text:

@@ -160,6 +160,20 @@ perf_counter/sleep` (and `_ns` variants) or `datetime.now/utcnow/
 today` call in those two files is forbidden: order by logical
 sequence, measure in the server layer on the telemetry clock.
 
+Fifteenth rule: NO raw clock in the metrics-history store or the
+regression sentinel. The history store (`polyaxon_tpu/telemetry/
+history.py`) timestamps nothing itself — every sample's `t` comes from
+the caller (the sampler's injected clock), which is what lets the tests
+replay deterministic histories and the downsampler/retention math stay
+reproducible. The sentinel (`polyaxon_tpu/telemetry/detect.py`)
+evaluates rules at an injected `clock=` time for the same reason: a raw
+`time.*()` read in either would couple stored timestamps and rule
+windows to the host clock, so `rate()` and EWMA baselines could not be
+pinned against exact references. Any direct `time.time/monotonic/
+perf_counter/sleep` (and `_ns` variants) or `datetime.now/utcnow/today`
+call in those two files is forbidden: timestamps come in through
+`append(sample)`, evaluation time through the injected clock.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -263,6 +277,17 @@ SPILL_MODULES = (
     ("polyaxon_tpu", "serving", "spill.py"),
     ("polyaxon_tpu", "serving", "affinity.py"),
 )
+HISTORY_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: the metrics-history store timestamps nothing (sample `t` comes from
+#: the caller) and the regression sentinel evaluates at an injected
+#: clock — both must replay deterministic histories (rule 15)
+HISTORY_MODULES = (
+    ("polyaxon_tpu", "telemetry", "history.py"),
+    ("polyaxon_tpu", "telemetry", "detect.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -295,6 +320,18 @@ def violations(repo_root: Path) -> list[str]:
                             f"{rel}:{i}: clock in a pure transform — "
                             f"federation/timeline code has no time "
                             f"axis: {line.strip()}"
+                        )
+            if rel.parts in HISTORY_MODULES:
+                for i, line in enumerate(
+                    py.read_text().splitlines(), 1
+                ):
+                    code = line.split("#", 1)[0]
+                    if HISTORY_PATTERN.search(code):
+                        out.append(
+                            f"{rel}:{i}: raw clock in the metrics "
+                            f"history/sentinel layer — timestamps come "
+                            f"from callers, evaluation time from the "
+                            f"injected clock: {line.strip()}"
                         )
             continue
         in_scheduler = rel.parts[:2] == ("polyaxon_tpu", "scheduler")

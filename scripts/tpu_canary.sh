@@ -1491,6 +1491,148 @@ PY
       echo "EVENTLOG-CRASH-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # metrics-history gate (ISSUE 18): a server armed with the history
+    # sampler + a latency regression rule, warm fast traffic, then a
+    # chaos-injected decode slowdown (serving.slow sleeps). The sentinel
+    # must flip regression_active on the REAL latency surge, land a
+    # perf_regression event in the run's event log, and leave a
+    # flight-recorder bundle with the offending series window; the
+    # history series must be live on /metricsz and /queryz must answer
+    # with the recorded points. A regression detector that sleeps
+    # through a 10x slowdown — or a history plane that is dark — FAILS.
+    echo "running metrics-history smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import pathlib
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.chaos.injector import active
+from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+from polyaxon_tpu.store import RunStore
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 256}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+home = tempfile.mkdtemp(prefix="canary-history-store-")
+store = RunStore(home)
+uid = "canaryhist0001"
+store.create_run(uid, "canary-history", "default", {"kind": "test"})
+hist_dir = tempfile.mkdtemp(prefix="canary-history-")
+debug_dir = tempfile.mkdtemp(prefix="canary-history-debug-")
+server = ModelServer(
+    b.module, params,
+    config=ServingConfig(max_batch=4, max_wait_ms=10.0),
+    history={"dir": hist_dir, "interval_s": 0.05},
+    regression_rules=[{
+        "name": "latency-surge", "series": "serving.request_seconds",
+        "kind": "window_ratio", "agg": "p95", "window_s": 2.0,
+        "threshold": 2.0, "min_samples": 4,
+    }],
+    debug_dir=debug_dir,
+    event_sink=lambda kind, body: store.log_event(uid, kind, body),
+)
+port = server.start(port=0)
+try:
+    body = json.dumps({"tokens": [[1, 2, 3, 4]], "maxNewTokens": 4,
+                       "temperature": 0.5, "topK": 10, "seed": 0}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=300).read()
+
+    # warm window: fast requests fill the baseline half of the ratio
+    post()  # compile out of the way first
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 2.0:
+        post()
+        time.sleep(0.02)
+    server.sentinel.evaluate()
+    if any(r["active"] for r in server.sentinel.last):
+        print("history smoke: rule fired on the WARM baseline",
+              server.sentinel.last)
+        sys.exit(1)
+    # surge window: every decode batch stalls 150ms under chaos — the
+    # p95 of the recent window must dwarf the warm window's
+    with active(FaultPlan([Fault("serving.slow", "sleep", at=0,
+                                 count=10_000, delay_ms=150.0)])):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 2.2:
+            post()
+    results = server.sentinel.evaluate()
+    fired = [r for r in results if r["active"]]
+    if not fired:
+        print("history smoke: 150ms chaos slowdown never flipped "
+              "regression_active", results)
+        sys.exit(1)
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+    q = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/queryz?series=serving.request_seconds"
+        "&agg=p95&last=10&step=2", timeout=30,
+    ).read())
+finally:
+    server.stop()
+with open("tpu_results/history_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = ("history_samples_total", "history_bytes", "regression_active")
+missing = [s for s in required if s not in text]
+if missing:
+    print("history smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+active_lines = [l for l in text.splitlines()
+                if l.startswith("regression_active ")]
+if not active_lines or float(active_lines[0].split()[1]) < 1:
+    print("history smoke: regression_active gauge not >= 1 after the "
+          "edge", active_lines)
+    sys.exit(1)
+if not any(v is not None for _, v in q.get("points", [])):
+    print("history smoke: /queryz returned no recorded points", q)
+    sys.exit(1)
+events = [e for e in store.read_events(uid)
+          if e.get("kind") == "perf_regression"]
+if not events:
+    print("history smoke: no perf_regression event in the run log")
+    sys.exit(1)
+if not events[0].get("history_window"):
+    print("history smoke: perf_regression event carries no series window",
+          events[0])
+    sys.exit(1)
+bundles = sorted(pathlib.Path(debug_dir).glob("slo-*/breach.json"))
+if not bundles:
+    print("history smoke: regression edge left no flight-recorder bundle "
+          f"under {debug_dir}")
+    sys.exit(1)
+burst = json.loads(bundles[0].read_text())
+if not burst.get("history_window"):
+    print("history smoke: breach bundle missing history_window", burst)
+    sys.exit(1)
+print(f"metrics-history smoke: ok ({len(required)} required series "
+      f"present, rule {fired[0]['name']!r} fired at ratio "
+      f"{fired[0].get('ratio'):.1f}, perf_regression event landed, "
+      f"bundle at {bundles[0].parent})")
+PY
+    then
+      echo "HISTORY-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

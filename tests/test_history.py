@@ -1,0 +1,963 @@
+"""ISSUE 18 coverage: the embedded metrics-history store, rate/trend
+queries, and the perf-regression sentinel.
+
+Unit layer: CRC-framed tiered store semantics under a fake clock
+(append/samples/de-dup, last-per-bucket downsampling that loses no rate
+information, byte-bounded tiered retention), the PR 11 heal contract
+under the seeded `history.append` chaos sweep (kill / scramble_tail /
+corrupt_segment), query aggregation math pinned against hand-computed
+references (avg windows, counter-reset-aware rate, bucket-interpolated
+percentiles), every BadQuery shape, the federated `cluster:*:sum`
+reset clamp promised by telemetry/federate.py's docstring, sentinel
+rule kinds + edge-triggering (one event per inactive→active transition,
+`rule_kind` in the body — the run event log flattens bodies, so a
+`kind` key would clobber the event kind), flight-recorder bundles,
+scenario trend/floor predicates, and the `polyaxon top` sparkline.
+
+Live-HTTP layer (pytest.mark.serving, tiny models): /queryz on all
+three surfaces (serving server, router with federated series, streams
+server), the history health series on /metricsz, and the CLI round
+trips — `polyaxon query`, `polyaxon trace --export`, and
+`polyaxon perf diff` gating against a bench record.
+"""
+
+import json
+import http.client
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.chaos import injector
+from polyaxon_tpu.chaos.injector import SimulatedKill
+from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+from polyaxon_tpu.telemetry import MetricsRegistry
+from polyaxon_tpu.telemetry.federate import parse_prometheus_text
+from polyaxon_tpu.telemetry.history import (
+    AGGS,
+    BadQuery,
+    HistorySampler,
+    HistoryStore,
+    TIERS,
+    percentile_from_counts,
+    queryz_payload,
+    rate_over,
+    sample_from_snapshots,
+    sample_registry,
+)
+from polyaxon_tpu.telemetry.detect import (
+    DEFAULT_SERVING_RULES,
+    RegressionRule,
+    RegressionSentinel,
+    build_rules,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _scalar(t, **series):
+    return {"t": t, "s": {k: float(v) for k, v in series.items()}}
+
+
+# ----------------------------------------------------------- store unit
+
+
+def test_append_samples_roundtrip_and_window_filter(tmp_path):
+    store = HistoryStore(tmp_path)
+    for i in range(10):
+        store.append(_scalar(float(i), m=i))
+    recs = store.samples()
+    assert [r["t"] for r in recs] == [float(i) for i in range(10)]
+    assert store.series_names() == ["m"]
+    window = store.samples(since=3.0, until=6.0)
+    assert [r["s"]["m"] for r in window] == [3.0, 4.0, 5.0, 6.0]
+    assert store.total_bytes() > 0
+    assert store.heal_stats == {"clean": 0, "torn": 0, "corrupt": 0}
+
+
+def test_raw_tier_shadows_coarse_on_duplicate_timestamp(tmp_path):
+    store = HistoryStore(tmp_path)
+    store.append(_scalar(100.0, m=999.0), tier="1m")
+    store.append(_scalar(100.0, m=1.0))  # raw copy of the same instant
+    recs = store.samples()
+    assert len(recs) == 1
+    assert recs[0]["s"]["m"] == 1.0  # finer tier wins
+
+
+def test_tiered_retention_bounds_bytes_and_preserves_rate(tmp_path):
+    store = HistoryStore(tmp_path, max_bytes=4096, segment_bytes=1024)
+    assert store.max_bytes == 4096 and store.segment_bytes == 1024
+    # a monotone 1/sec counter: downsampling keeps the last cumulative
+    # state per bucket, so the full-span rate must survive eviction
+    for i in range(600):
+        store.append(_scalar(float(i), c=i))
+    assert store.total_bytes() <= store.max_bytes
+    assert store._segments("10s"), "raw overflow must downsample, not drop"
+    res = store.query("c", agg="rate")
+    assert res["points"][0][1] == pytest.approx(1.0)
+    assert res["resets"] == 0
+    # only a fraction of the raw samples survive, all time-ordered
+    recs = store.samples()
+    assert 2 <= len(recs) < 600
+    ts = [r["t"] for r in recs]
+    assert ts == sorted(ts)
+
+
+def test_heal_truncates_torn_tail_and_keeps_committed(tmp_path):
+    store = HistoryStore(tmp_path)
+    for i in range(3):
+        store.append(_scalar(float(i), m=i))
+    seg = store._segments("raw")[-1]
+    with seg.open("ab") as f:
+        f.write(b"\x13garbage-torn-tail")
+    reopened = HistoryStore(tmp_path)
+    assert reopened.heal_stats["torn"] == 1
+    assert [r["s"]["m"] for r in reopened.samples()] == [0.0, 1.0, 2.0]
+    # the healed store accepts new appends on the truncated segment
+    reopened.append(_scalar(3.0, m=3.0))
+    assert len(reopened.samples()) == 4
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_chaos_kill_mid_append_commits_prefix(tmp_path, seed):
+    at = 2 + seed % 4
+    store = HistoryStore(tmp_path)
+    plan = FaultPlan([Fault("history.append", "kill", at=at)], seed=seed)
+    appended = 0
+    with injector.active(plan):
+        with pytest.raises(SimulatedKill):
+            for i in range(12):
+                store.append(_scalar(float(i), m=i))
+                appended += 1
+    assert appended == at  # the injection fires before the write lands
+    reopened = HistoryStore(tmp_path)
+    assert [r["s"]["m"] for r in reopened.samples()] == [
+        float(i) for i in range(at)
+    ]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [3, 11, 41])
+def test_chaos_scramble_tail_heals_to_last_frame(tmp_path, seed):
+    at = 1 + seed % 5
+    store = HistoryStore(tmp_path)
+    plan = FaultPlan(
+        [Fault("history.append", "scramble_tail", at=at)], seed=seed
+    )
+    with injector.active(plan):
+        with pytest.raises(SimulatedKill):
+            for i in range(12):
+                store.append(_scalar(float(i), m=i))
+    reopened = HistoryStore(tmp_path)
+    assert reopened.heal_stats["torn"] == 1
+    assert [r["s"]["m"] for r in reopened.samples()] == [
+        float(i) for i in range(at)
+    ]
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_segment_quarantines_never_wedges(tmp_path):
+    store = HistoryStore(tmp_path)
+    for i in range(3):
+        store.append(_scalar(float(i), m=i))
+    plan = FaultPlan(
+        [Fault("history.append", "corrupt_segment", at=0)], seed=5
+    )
+    with injector.active(plan):
+        store.append(_scalar(3.0, m=3.0))  # bit rot lands, append proceeds
+    reopened = HistoryStore(tmp_path)
+    assert reopened.heal_stats["corrupt"] == 1
+    assert list(tmp_path.glob("*.corrupt")), "forensics copy must exist"
+    # the store boots, queries answer, and new appends land
+    reopened.append(_scalar(4.0, m=4.0))
+    assert reopened.samples()[-1]["s"]["m"] == 4.0
+
+
+# ----------------------------------------------------------- query math
+
+
+def test_query_avg_windows_exact(tmp_path):
+    store = HistoryStore(tmp_path)
+    for i in range(10):
+        store.append(_scalar(float(i), m=i))
+    res = store.query("m", since=0, until=9, step=3, agg="avg")
+    assert res["points"] == [
+        [0.0, pytest.approx(1.5)],  # 0,1,2,3 (window ends inclusive)
+        [3.0, pytest.approx(4.5)],  # 3,4,5,6
+        [6.0, pytest.approx(7.5)],  # 6,7,8,9
+    ]
+    assert res["samples"] == 10
+    assert store.query("m", agg="min")["points"][0][1] == 0.0
+    assert store.query("m", agg="max")["points"][0][1] == 9.0
+    # empty window aggregates to None, not zero
+    sparse = store.query("m", since=0, until=100, step=50, agg="avg")
+    assert sparse["points"][1][1] is None
+
+
+def test_query_rate_simple_counter(tmp_path):
+    store = HistoryStore(tmp_path)
+    for i in range(11):
+        store.append(_scalar(float(i), c=5 * i))
+    res = store.query("c", agg="rate")
+    assert res["points"][0][1] == pytest.approx(5.0)
+    assert res["resets"] == 0
+
+
+def test_query_rate_counter_reset_clamped(tmp_path):
+    store = HistoryStore(tmp_path)
+    for t, v in enumerate([0, 10, 20, 5, 15]):
+        store.append(_scalar(float(t), c=v))
+    res = store.query("c", agg="rate")
+    # 10+10 before the restart, 5 counted from zero, 10 after: never
+    # a negative delta, and the restart is annotated
+    assert res["points"][0][1] == pytest.approx(35 / 4)
+    assert res["resets"] == 1
+
+
+def test_rate_over_reference_pins():
+    # the last sample BEFORE the window is the rate base
+    assert rate_over([(0.0, 0.0), (10.0, 50.0)], 5.0, 10.0) == (
+        pytest.approx(5.0),
+        0,
+    )
+    assert rate_over([(0.0, 0.0)], 0.0, 10.0) == (None, 0)
+    assert rate_over([], 0.0, 10.0) == (None, 0)
+    v, resets = rate_over(
+        [(0.0, 0.0), (1.0, 10.0), (3.0, 4.0)], 0.0, 3.0
+    )
+    assert v == pytest.approx(14 / 3) and resets == 1
+
+
+def test_percentile_from_counts_interpolation():
+    bounds = [1.0, 2.0, 4.0]
+    assert percentile_from_counts([0, 10, 0, 0], bounds, 0.5) == (
+        pytest.approx(1.5)
+    )
+    assert percentile_from_counts([0, 10, 0, 0], bounds, 0.95) == (
+        pytest.approx(1.95)
+    )
+    # overflow bucket clamps to the top bound
+    assert percentile_from_counts([0, 0, 0, 10], bounds, 0.5) == 4.0
+    assert percentile_from_counts([0, 0, 0, 0], bounds, 0.5) is None
+    assert percentile_from_counts([], [], 0.5) is None
+
+
+def test_query_percentiles_from_histogram_window_delta(tmp_path):
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0, 2.0, 4.0))
+    store = HistoryStore(tmp_path)
+    for _ in range(4):
+        h.observe(0.5)
+    store.append(sample_registry(reg, 0.0))
+    for _ in range(4):
+        h.observe(3.0)
+    store.append(sample_registry(reg, 10.0))
+    # window [5, 10]: start = t0 state, end = t10 state, delta = the
+    # four 3.0 observations → interpolated inside the (2, 4] bucket
+    res = store.query("lat", since=5, until=10, agg="p50")
+    assert res["points"][0][1] == pytest.approx(3.0)
+    res95 = store.query("lat", since=5, until=10, agg="p95")
+    assert res95["points"][0][1] == pytest.approx(2 + 2 * 0.95)
+    # whole-span window has no start state: end counts alone, mixed —
+    # rank 4 of 8 sits exactly at the top of the (0, 1] bucket
+    both = store.query("lat", agg="p50")
+    assert both["points"][0][1] == pytest.approx(1.0)
+
+
+def test_query_histogram_reset_falls_back_to_end_counts(tmp_path):
+    store = HistoryStore(tmp_path)
+    store.append(
+        {"t": 0.0, "h": {"lat": [[5, 5], 10.0, 10]}, "hb": {"lat": [1.0]}}
+    )
+    store.append(  # a bucket decreased: the process restarted
+        {"t": 10.0, "h": {"lat": [[2, 0], 1.0, 2]}, "hb": {"lat": [1.0]}}
+    )
+    res = store.query("lat", since=5, until=10, agg="p50")
+    assert res["resets"] == 1
+    assert res["points"][0][1] is not None
+
+
+def test_query_bad_query_shapes(tmp_path):
+    store = HistoryStore(tmp_path)
+    with pytest.raises(BadQuery):
+        store.query("anything", agg="avg")  # empty store
+    store.append(_scalar(0.0, m=1.0))
+    store.append(
+        {"t": 0.0, "h": {"lat": [[1, 0], 0.5, 1]}, "hb": {"lat": [1.0]}}
+    )
+    store.append({"t": 1.0, "h": {"nb": [[1, 0], 0.5, 1]}})  # no bounds
+    with pytest.raises(BadQuery):
+        store.query("m", agg="median")
+    with pytest.raises(BadQuery):
+        store.query("nope")
+    with pytest.raises(BadQuery):
+        store.query("m", since=10, until=0)
+    with pytest.raises(BadQuery):
+        store.query("m", since=0, until=100_000, step=1)
+    with pytest.raises(BadQuery):
+        store.query("lat", agg="avg")  # scalar agg on a histogram
+    with pytest.raises(BadQuery):
+        store.query("m", agg="p95")  # percentile on a scalar
+    with pytest.raises(BadQuery):
+        store.query("nb", agg="p50")  # histogram without bounds
+    assert "median" not in AGGS
+
+
+# ------------------------------------------------- sampling / federation
+
+
+def test_sample_registry_shape():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(3)
+    reg.gauge("g").set(7.5)
+    reg.histogram("h", buckets=(1.0,)).observe(0.5)
+    rec = sample_registry(reg, 42.0)
+    assert rec["t"] == 42.0
+    assert rec["s"]["c"] == 3.0 and rec["s"]["g"] == 7.5
+    counts, hsum, hcount = rec["h"]["h"]
+    assert counts == [1, 0] and hsum == 0.5 and hcount == 1
+    assert rec["hb"]["h"] == [1.0]
+
+
+def test_sample_from_snapshots_federates_and_skips_buckets():
+    snap = parse_prometheus_text(
+        "foo_total 100\nbar 5\nqux_bucket 3\n"
+    )
+    rec = sample_from_snapshots([("r0", snap), ("r1", None)], 9.0)
+    s = rec["s"]
+    assert s['federation_source_up{replica="r0"}'] == 1.0
+    assert s['federation_source_up{replica="r1"}'] == 0.0
+    assert s['foo_total{replica="r0"}'] == 100.0
+    assert s["cluster:foo_total:sum"] == 100.0
+    assert s["cluster:bar:sum"] == 5.0
+    assert not any("qux_bucket" in k for k in s)
+
+
+def test_federated_cluster_sum_reset_clamp(tmp_path):
+    """The hazard pinned in telemetry/federate.py's docstring: one
+    source restarting drops the instantaneous `cluster:*:sum`, and
+    rate() must read that as a reset — never a negative rate."""
+    store = HistoryStore(tmp_path)
+
+    def snaps(va, vb):
+        return [
+            ("a", parse_prometheus_text(f"req_total {va}\n")),
+            ("b", parse_prometheus_text(f"req_total {vb}\n")),
+        ]
+
+    store.append(sample_from_snapshots(snaps(100, 50), 0.0))  # sum 150
+    store.append(sample_from_snapshots(snaps(110, 60), 10.0))  # 170
+    store.append(sample_from_snapshots(snaps(120, 0), 20.0))  # b restarted
+    store.append(sample_from_snapshots(snaps(130, 10), 30.0))  # 140
+    res = store.query("cluster:req_total:sum", agg="rate")
+    rate = res["points"][0][1]
+    assert rate is not None and rate >= 0
+    assert rate == pytest.approx((20 + 120 + 20) / 30)
+    assert res["resets"] == 1
+    per = store.query('req_total{replica="b"}', agg="rate")
+    assert per["points"][0][1] == pytest.approx(20 / 30)
+    assert per["resets"] == 1
+
+
+def test_history_sampler_fake_clock_and_health_metrics(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c").inc(3)
+    clk = FakeClock(t=50.0)
+    store = HistoryStore(tmp_path / "h")
+    sampler = HistorySampler(reg, store, interval_s=1.0, clock=clk)
+    rec = sampler.sample_once()
+    assert rec["t"] == 50.0 and rec["s"]["c"] == 3.0
+    clk.tick(10.0)
+    sampler.sample_once()
+    assert reg.counter("history.samples").value == 2
+    assert reg.gauge("history.bytes").value == store.total_bytes() > 0
+    assert reg.gauge("history.healed_segments").value == 0
+    assert [r["t"] for r in store.samples()] == [50.0, 60.0]
+    # a reopened-after-torn-tail store surfaces on the healed gauge
+    seg = store._segments("raw")[-1]
+    with seg.open("ab") as f:
+        f.write(b"\x09torn")
+    reg2 = MetricsRegistry()
+    HistorySampler(reg2, HistoryStore(tmp_path / "h"), clock=clk)
+    assert reg2.gauge("history.healed_segments").value == 1
+
+
+def test_queryz_payload_contract(tmp_path):
+    assert queryz_payload(None, "") == (503, {"error": "history disabled"})
+    store = HistoryStore(tmp_path)
+    code, listing = queryz_payload(store, "")
+    assert code == 200 and listing["series"] == []
+    assert set(listing["tiers"]) == set(TIERS)
+    store.append(_scalar(0.0, m=1.0))
+    store.append(_scalar(1.0, m=3.0))
+    code, listing = queryz_payload(store, None)
+    assert code == 200 and listing["series"] == ["m"]
+    code, res = queryz_payload(store, "series=m&agg=avg")
+    assert code == 200 and res["points"][0][1] == pytest.approx(2.0)
+    assert queryz_payload(store, "series=m&agg=bogus")[0] == 400
+    assert queryz_payload(store, "series=zzz")[0] == 400
+    assert queryz_payload(store, "series=m&last=abc")[0] == 400
+
+
+# -------------------------------------------------------------- sentinel
+
+
+def _fill(store, t0, t1, value, step=30.0):
+    t = t0
+    while t <= t1:
+        store.append(_scalar(t, m=value))
+        t += step
+
+
+def test_sentinel_edge_fires_once_and_rearms(tmp_path):
+    reg = MetricsRegistry()
+    store = HistoryStore(tmp_path)
+    events = []
+    sentinel = RegressionSentinel(
+        store,
+        reg,
+        build_rules(
+            [{"name": "m-high", "series": "m", "kind": "ceiling",
+              "threshold": 5.0, "window_s": 60.0}]
+        ),
+        on_event=lambda kind, body: events.append((kind, body)),
+        clock=FakeClock(0.0),
+    )
+    _fill(store, 0, 120, 1.0)
+    res = sentinel.evaluate()
+    assert not res[0]["active"] and not events
+    assert reg.gauge("regression.active").value == 0.0
+    # spike: the last 60s window's avg crosses the ceiling
+    _fill(store, 150, 180, 10.0)
+    res = sentinel.evaluate()
+    assert res[0]["active"] and res[0]["edge"]
+    assert len(events) == 1
+    kind, body = events[0]
+    assert kind == "perf_regression"
+    # the run event log flattens bodies: the rule's kind must travel
+    # under its own name so it cannot clobber the event kind
+    assert "kind" not in body
+    assert body["rule_kind"] == "ceiling"
+    assert body["name"] == "m-high" and body["value"] == pytest.approx(7.0)
+    assert body["history_window"] and "window" not in body
+    assert reg.gauge("regression.active").value == 1.0
+    assert reg.gauge("regression.active.m_high").value == 1.0
+    # still active: level-triggered gauges, no second event
+    sentinel.evaluate()
+    assert len(events) == 1
+    # recovery re-arms the edge
+    _fill(store, 210, 330, 1.0)
+    assert not sentinel.evaluate()[0]["active"]
+    assert reg.gauge("regression.active.m_high").value == 0.0
+    _fill(store, 360, 390, 10.0)
+    assert sentinel.evaluate()[0]["edge"]
+    assert len(events) == 2
+    assert sentinel.last[0]["active"]
+    assert sentinel.to_dict()["active"] == ["m-high"]
+
+
+def test_sentinel_rule_kinds_reference_verdicts(tmp_path):
+    store = HistoryStore(tmp_path)
+    # ratio series: [0,60] avg 10, [60,120] avg (10+30+30)/3
+    _fill(store, 0, 60, 10.0)
+    _fill(store, 90, 120, 30.0)
+    ratio = RegressionRule(
+        {"name": "r", "series": "m", "kind": "window_ratio",
+         "threshold": 2.0, "window_s": 60.0}
+    )
+    res = ratio.evaluate(store, 120.0)
+    assert res["active"] and res["ratio"] == pytest.approx(70 / 30)
+    assert res["baseline"] == pytest.approx(10.0)
+    below = RegressionRule(
+        {"name": "b", "series": "m", "kind": "ceiling",
+         "threshold": 5.0, "direction": "below", "window_s": 60.0}
+    )
+    assert not below.evaluate(store, 120.0)["active"]
+    # ewma drift over three 10s windows: baseline 10, last window 17.5
+    store2 = HistoryStore(tmp_path / "d")
+    for t, v in [(0, 10), (10, 10), (20, 10), (30, 25)]:
+        store2.append(_scalar(float(t), m=v))
+    drift = RegressionRule(
+        {"name": "d", "series": "m", "kind": "ewma_drift",
+         "threshold": 0.5, "window_s": 10.0, "lookback_windows": 3}
+    )
+    res = drift.evaluate(store2, 30.0)
+    assert res["baseline"] == pytest.approx(10.0)
+    assert res["value"] == pytest.approx(17.5)
+    assert res["active"]  # 17.5 > 10 * 1.5
+    # an unqueryable series is an inactive rule, never a raise
+    ghost = RegressionRule(
+        {"name": "g", "series": "ghost", "kind": "ceiling", "threshold": 1}
+    )
+    assert not ghost.evaluate(store, 120.0)["active"]
+    # min_samples guards thin histories
+    thin = RegressionRule(
+        {"name": "t", "series": "m", "kind": "ceiling",
+         "threshold": 0.1, "window_s": 60.0, "min_samples": 100}
+    )
+    assert not thin.evaluate(store, 120.0)["active"]
+
+
+def test_sentinel_flight_recorder_bundle(tmp_path):
+    from polyaxon_tpu.telemetry import FlightRecorder
+
+    reg = MetricsRegistry()
+    store = HistoryStore(tmp_path / "h")
+    recorder = FlightRecorder(tmp_path / "dbg")
+    sentinel = RegressionSentinel(
+        store,
+        reg,
+        build_rules(
+            [{"name": "m-high", "series": "m", "kind": "ceiling",
+              "threshold": 5.0, "window_s": 60.0}]
+        ),
+        recorder=recorder,
+        clock=FakeClock(0.0),
+    )
+    _fill(store, 0, 120, 1.0)
+    _fill(store, 150, 180, 10.0)
+    sentinel.evaluate()
+    bundles = sorted((tmp_path / "dbg").glob("slo-*-m_high/breach.json"))
+    assert len(bundles) == 1
+    breach = json.loads(bundles[0].read_text())
+    assert breach["name"] == "m-high"
+    assert breach["rule_kind"] == "ceiling"
+    assert breach["history_window"]
+
+
+def test_sentinel_event_kind_survives_run_store_flattening(tmp_path):
+    """End-to-end pin of the flattening hazard: a sentinel edge logged
+    through RunStore.log_event must still read back as a
+    `perf_regression` event (not as the rule's kind)."""
+    from polyaxon_tpu.store.local import RunStore
+
+    store = RunStore(tmp_path / "runs")
+    uid = "histsent0001aaaa"
+    store.create_run(uid, "hist-sentinel", "default", {"kind": "test"})
+    hist = HistoryStore(tmp_path / "h")
+    sentinel = RegressionSentinel(
+        hist,
+        MetricsRegistry(),
+        build_rules(
+            [{"name": "surge", "series": "m", "kind": "window_ratio",
+              "threshold": 2.0, "window_s": 60.0}]
+        ),
+        on_event=lambda kind, body: store.log_event(uid, kind, body),
+        clock=FakeClock(0.0),
+    )
+    _fill(hist, 0, 60, 10.0)
+    _fill(hist, 90, 120, 30.0)
+    sentinel.evaluate()
+    events = [
+        e for e in store.read_events(uid) if e["kind"] == "perf_regression"
+    ]
+    assert len(events) == 1
+    assert events[0]["rule_kind"] == "window_ratio"
+    assert events[0]["name"] == "surge"
+    assert events[0]["history_window"]
+
+
+def test_build_rules_validation():
+    rules = build_rules(DEFAULT_SERVING_RULES)
+    assert [r.name for r in rules] == [
+        "ttft-creep", "queue-wait-trend", "accept-rate-collapse",
+        "kv-spill-surge",
+    ]
+    with pytest.raises(ValueError, match="duplicate"):
+        build_rules(
+            [{"name": "x", "series": "m", "threshold": 1}] * 2
+        )
+    with pytest.raises(ValueError, match="kind"):
+        RegressionRule(
+            {"name": "x", "series": "m", "kind": "nope", "threshold": 1}
+        )
+    with pytest.raises(ValueError, match="direction"):
+        RegressionRule(
+            {"name": "x", "series": "m", "threshold": 1,
+             "direction": "sideways"}
+        )
+    with pytest.raises(ValueError, match="window_s"):
+        RegressionRule(
+            {"name": "x", "series": "m", "threshold": 1, "window_s": 0}
+        )
+    clamped = RegressionRule(
+        {"name": "x", "series": "m", "threshold": 1,
+         "lookback_windows": 1, "min_samples": 0}
+    )
+    assert clamped.lookback_windows == 2 and clamped.min_samples == 1
+
+
+# --------------------------------------------- scenario trend predicates
+
+
+def test_half_means_and_trend_floor_predicates():
+    from polyaxon_tpu.scenarios.registry import (
+        Assertions,
+        evaluate,
+        half_means,
+    )
+
+    assert half_means([1, 2, 3]) == (None, None)  # too thin
+    assert half_means([1, 1, 2, 2]) == (1.0, 2.0)
+    assert half_means([1, None, 1, 2, 2]) == (1.0, 2.0)  # Nones dropped
+
+    a = Assertions(
+        max_metric_trend={"latency_ms": 3.0},
+        min_metric_floor={"ok": 0.5},
+    )
+    summary = {"hung": 0, "shed_rate": 0.0, "ok": 8, "disconnected": 0}
+
+    def verdict(history, name):
+        out = evaluate(a, summary, {}, history)
+        return next(v for v in out if v["assertion"] == name)
+
+    good = {"latency_ms": [1, 1, 1, 1, 2, 2, 2, 2], "ok": [1, 1, 1, 1]}
+    assert verdict(good, "max_metric_trend:latency_ms")["ok"]
+    assert verdict(good, "min_metric_floor:ok")["ok"]
+    drifting = {"latency_ms": [1, 1, 1, 1, 10, 10, 10, 10], "ok": good["ok"]}
+    assert not verdict(drifting, "max_metric_trend:latency_ms")["ok"]
+    sagging = {"latency_ms": good["latency_ms"], "ok": [1, 1, 0, 0]}
+    assert not verdict(sagging, "min_metric_floor:ok")["ok"]
+    # thin history: trend is vacuous-pass, a floor with no samples fails
+    thin = {"latency_ms": [1, 2], "ok": []}
+    v = verdict(thin, "max_metric_trend:latency_ms")
+    assert v["ok"] and "vacuous" in v["detail"]
+    assert not verdict(thin, "min_metric_floor:ok")["ok"]
+
+
+def test_trend_tape_stride_doubling_keeps_halves():
+    from polyaxon_tpu.scenarios.twin import TrendTape
+
+    tape = TrendTape(cap=8)
+    for i in range(32):
+        tape.add(float(i))
+    assert len(tape.points) <= 8
+    assert tape.points[0] == 0.0
+    diffs = {
+        b - a for a, b in zip(tape.points, tape.points[1:])
+    }
+    assert len(diffs) == 1  # uniform stride: halves stay halves
+    assert tape.points == [0.0, 8.0, 16.0, 24.0]
+
+
+def test_scenarios_carry_history_assertions():
+    from polyaxon_tpu.scenarios.registry import SCENARIOS
+
+    for name in ("diurnal_soak", "prefix_storm"):
+        a = SCENARIOS[name].assertions
+        assert a.max_metric_trend == {"latency_ms": 3.0}
+        assert a.min_metric_floor == {"ok": 0.5}
+
+
+# -------------------------------------------------------------- sparkline
+
+
+def test_sparkline_pure_pins():
+    from polyaxon_tpu.cli.top import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([None, None]) == ""
+    assert sparkline([1.0, 1.0, 1.0]) == "▁▁▁"  # flat renders low, not empty
+    assert sparkline([0.0, 7.0]) == "▁█"
+    assert sparkline([0.0, None, 7.0]) == "▁ █"
+    assert sparkline(list(range(100)), width=4) == "▁▃▅█"
+
+
+def test_lint_rule_15_clock_free_history_layer():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_telemetry.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ------------------------------------------------------------- live HTTP
+
+CFG = {
+    "preset": "tiny", "seq_len": 64, "n_layers": 2, "dim": 64,
+    "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128,
+}
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import build_model
+
+    b = build_model("transformer_lm", CFG)
+    params = b.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )["params"]
+    return b.module, params
+
+
+def _server(module, params, **kw):
+    from polyaxon_tpu.serving.batching import ServingConfig
+    from polyaxon_tpu.serving.server import ModelServer
+
+    server_kw = {
+        k: kw.pop(k)
+        for k in (
+            "slos", "debug_dir", "registry", "history",
+            "regression_rules", "event_sink",
+        )
+        if k in kw
+    }
+    cfg = ServingConfig(**{
+        "max_batch": 4, "max_wait_ms": 2.0, "kv_page_tokens": 8,
+        "stream_chunk_tokens": 3, **kw,
+    })
+    return ModelServer(
+        module, params, model_name="tiny", config=cfg, **server_kw
+    )
+
+
+@pytest.fixture(scope="module")
+def hist_server(tmp_path_factory):
+    module, params = _build()
+    hist_dir = tmp_path_factory.mktemp("history")
+    srv = _server(
+        module, params, kv_pool_pages=64,
+        history={"dir": str(hist_dir), "interval_s": 0.05},
+        regression_rules=[
+            {"name": "latency-surge", "series": "serving.request_seconds",
+             "kind": "window_ratio", "agg": "p95", "window_s": 2.0,
+             "threshold": 2.0, "min_samples": 4}
+        ],
+    )
+    port = srv.start(port=0)
+    yield {"port": port, "srv": srv}
+    srv.stop()
+
+
+def _post(port, body, headers=None, path="/generate", timeout=120):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("POST", path, json.dumps(body), headers=headers or {})
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    return r.status, json.loads(raw)
+
+
+def _get(port, path, timeout=60):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("GET", path)
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    try:
+        return r.status, json.loads(raw)
+    except (ValueError, UnicodeDecodeError):
+        return r.status, raw
+
+
+def _body(n_rows=1, max_new=6, seed=123):
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, 100, size=12).tolist() for _ in range(n_rows)]
+    return {
+        "tokens": prompts, "maxNewTokens": max_new, "temperature": 0.0,
+        "seed": seed,
+    }
+
+
+@pytest.mark.serving
+def test_serving_queryz_and_health_series(hist_server):
+    port, srv = hist_server["port"], hist_server["srv"]
+    st, _ = _post(port, _body())
+    assert st == 200
+    srv.history_sampler.sample_once()
+    time.sleep(0.02)
+    srv.history_sampler.sample_once()
+    st, listing = _get(port, "/queryz")
+    assert st == 200
+    assert "serving.requests" in listing["series"]
+    assert "serving.ttft_ms" in listing["series"]
+    assert listing["bytes"] > 0
+    st, res = _get(
+        port, "/queryz?series=serving.requests&agg=rate&last=60&step=60"
+    )
+    assert st == 200 and res["agg"] == "rate" and res["points"]
+    st, res = _get(port, "/queryz?series=serving.ttft_ms&agg=p95&last=60")
+    assert st == 200
+    assert _get(port, "/queryz?series=serving.requests&agg=bogus")[0] == 400
+    st, text = _get(port, "/metricsz")
+    text = text.decode() if isinstance(text, bytes) else str(text)
+    for needle in (
+        "history_samples_total", "history_bytes", "regression_active",
+        "regression_active_latency_surge",
+    ):
+        assert needle in text
+    assert srv.sentinel is not None
+
+
+@pytest.mark.serving
+def test_router_federated_queryz(hist_server, tmp_path):
+    from polyaxon_tpu.serving.router import Router
+
+    r = Router(
+        [f"http://127.0.0.1:{hist_server['port']}"],
+        history={"dir": str(tmp_path / "rh")},
+        poll_interval_s=30.0,
+    )
+    r.poll_once()
+    time.sleep(0.05)
+    r.poll_once()
+    rport = r.start("127.0.0.1", 0)
+    try:
+        st, listing = _get(rport, "/queryz")
+        assert st == 200
+        names = set(listing["series"])
+        assert 'federation_source_up{replica="r0"}' in names
+        assert any(n.startswith("cluster:") for n in names)
+        assert 'serving_requests_total{replica="r0"}' in names
+        st, res = _get(
+            rport,
+            "/queryz?series=cluster:serving_requests_total:sum&agg=rate",
+        )
+        assert st == 200 and res["resets"] == 0
+        # the top dashboard's sparkline fetch rides the same surface
+        from polyaxon_tpu.cli.top import fetch_sparks
+
+        sparks = fetch_sparks(f"http://127.0.0.1:{rport}")
+        assert sparks and any(label == "req/s" for label, _ in sparks)
+    finally:
+        r.stop()
+
+
+@pytest.mark.serving
+def test_fetch_sparks_none_when_series_dark(hist_server):
+    # the serving surface has history but no router.* series: every
+    # spark query 400s and the pane disappears rather than rendering
+    from polyaxon_tpu.cli.top import fetch_sparks
+
+    assert fetch_sparks(f"http://127.0.0.1:{hist_server['port']}") is None
+
+
+def test_streams_server_queryz(tmp_path):
+    from polyaxon_tpu.store.local import RunStore
+    from polyaxon_tpu.streams.server import BackgroundServer
+
+    store = RunStore(tmp_path / "runs")
+    with BackgroundServer(store, history_dir=str(tmp_path / "sh")) as srv:
+        srv.server.history_sampler.sample_once()
+        time.sleep(0.02)
+        srv.server.history_sampler.sample_once()
+        st, listing = _get(srv.port, "/queryz")
+        assert st == 200 and listing["series"]
+        series = listing["series"][0]
+        from urllib.parse import quote
+
+        st, res = _get(
+            srv.port, f"/queryz?series={quote(series)}&agg=avg"
+        )
+        assert st == 200 and res["points"]
+    # history disabled → 503, the shared contract
+    with BackgroundServer(store) as srv:
+        st, err = _get(srv.port, "/queryz")
+        assert st == 503 and err["error"] == "history disabled"
+
+
+# ------------------------------------------------------------------- CLI
+
+
+@pytest.mark.serving
+def test_cli_query_listing_and_series(hist_server):
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+
+    url = f"http://127.0.0.1:{hist_server['port']}"
+    hist_server["srv"].history_sampler.sample_once()
+    res = CliRunner().invoke(cli, ["query", "--url", url])
+    assert res.exit_code == 0, res.output
+    assert res.output.startswith("history:")
+    assert "serving.requests" in res.output
+    res = CliRunner().invoke(
+        cli,
+        ["query", "serving.requests", "--url", url, "--agg", "rate",
+         "--last", "60", "--step", "60"],
+    )
+    assert res.exit_code == 0, res.output
+    assert "agg=rate" in res.output
+    res = CliRunner().invoke(
+        cli, ["query", "serving.requests", "--url", url, "--json"]
+    )
+    assert res.exit_code == 0
+    assert json.loads(res.output)["series"] == "serving.requests"
+
+
+@pytest.mark.serving
+def test_cli_trace_export_jsonl(hist_server, tmp_path):
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+
+    st, _ = _post(hist_server["port"], _body(seed=77))
+    assert st == 200
+    out = tmp_path / "traces.jsonl"
+    res = CliRunner().invoke(
+        cli,
+        ["trace", "--url", f"http://127.0.0.1:{hist_server['port']}",
+         "--export", str(out), "-n", "5"],
+    )
+    assert res.exit_code == 0, res.output
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert lines and all("id" in t for t in lines)
+    assert f"exported {len(lines)} traces" in res.output
+
+
+@pytest.mark.serving
+def test_cli_perf_diff_pass_and_gate(hist_server, tmp_path):
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+
+    url = f"http://127.0.0.1:{hist_server['port']}"
+    st, _ = _post(hist_server["port"], _body(seed=88))
+    assert st == 200
+    hist_server["srv"].history_sampler.sample_once()
+    generous = tmp_path / "bench_ok.json"
+    generous.write_text(json.dumps({"tail": '{"ttft_ms": 1e9}'}))
+    res = CliRunner().invoke(
+        cli,
+        ["perf", "diff", str(generous), "--url", url,
+         "--tolerance", "0.1"],
+    )
+    assert res.exit_code == 0, res.output
+    assert "compared 1 field(s): ok" in res.output
+    tight = tmp_path / "bench_tight.json"
+    tight.write_text(json.dumps({"tail": '{"ttft_ms": 1e-6}'}))
+    res = CliRunner().invoke(
+        cli,
+        ["perf", "diff", str(tight), "--url", url, "--tolerance", "0.0"],
+    )
+    assert res.exit_code != 0
+    assert "REGRESSED" in res.output
+    empty = tmp_path / "bench_empty.json"
+    empty.write_text(json.dumps({"tail": '{"other": 1.0}'}))
+    res = CliRunner().invoke(
+        cli, ["perf", "diff", str(empty), "--url", url]
+    )
+    assert res.exit_code != 0
+    assert "nothing compared" in res.output
