@@ -110,6 +110,21 @@ fleet-wide warm-KV picture):
    "spill_restores": ..., "cluster_prefix_hit_rate": ...,
    "byte_identical": true, "host_cores": C, "gate_enforced": bool}
 
+`--tenants` runs the ISSUE 19 multi-tenant records: the victim tenant's
+p95 under a noisy-neighbor flood vs alone (per-tenant admission sheds
+the flood as `tenant_quota`, the victim's tail must hold), and the
+adapter-multiplexing tax — a server hot-swapping three seeded LoRA
+adapters vs a plain LoRA twin, interleaved min-of-repeats, plus a churn
+phase pricing a real evict→spill→restore swap:
+
+  {"metric": "serving_tenant_isolation_p95_ratio", "value": ..., "unit":
+   "x", "victim_p95_alone_ms": ..., "victim_p95_contended_ms": ...,
+   "noisy_shed": ..., "victim_shed": 0, "host_cores": C,
+   "gate_enforced": bool}
+  {"metric": "serving_adapter_swap_overhead", "value": ..., "unit": "%",
+   "p95_multi_ms": ..., "p95_solo_ms": ..., "swap_p50_ms": ...,
+   "resident_p50_ms": ..., "swap_evictions": ..., "swap_restores": ...}
+
 `--interference` runs the ISSUE 14 chunked-prefill record: one long-
 prompt/long-decode request per round with a burst of short streamed
 requests fired while it is in flight, against an unchunked paged server
@@ -154,6 +169,7 @@ import random
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -201,15 +217,23 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float,
                  prefill_chunk_tokens: int = 64,
                  max_step_tokens: int = 256,
                  spill_ram_bytes: int | None = None,
-                 history: dict | None = None):
+                 history: dict | None = None,
+                 lora_rank: int = 0,
+                 adapters: dict | None = None,
+                 tenants: list | None = None,
+                 adapter_slots: int = 0):
     import jax
     import jax.numpy as jnp
 
     from polyaxon_tpu.models import build_model
     from polyaxon_tpu.serving.batching import ServingConfig
     from polyaxon_tpu.serving.server import ModelServer
+    from polyaxon_tpu.serving.tenancy import (
+        normalize_adapters, normalize_tenants,
+    )
 
-    bundle = build_model("transformer_lm", MODEL_CFG)
+    cfg = dict(MODEL_CFG, lora_rank=lora_rank) if lora_rank else MODEL_CFG
+    bundle = build_model("transformer_lm", cfg)
     params = bundle.module.init(
         {"params": jax.random.PRNGKey(0)},
         jnp.zeros((1, 8), jnp.int32),
@@ -227,6 +251,9 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float,
             prefill_chunk_tokens=prefill_chunk_tokens,
             max_step_tokens=max_step_tokens,
             spill_ram_bytes=spill_ram_bytes,
+            adapters=normalize_adapters(adapters or {}),
+            tenants=normalize_tenants(tenants or []),
+            adapter_slots=adapter_slots,
         ),
         history=history,
     )
@@ -1124,6 +1151,293 @@ def drive_affinity(max_batch: int, max_wait_ms: float, seed: int,
             s.stop()
 
 
+def drive_tenants(clients: int, requests: int, max_batch: int,
+                  max_wait_ms: float, repeats: int, seed: int,
+                  smoke: bool) -> list[dict]:
+    """ISSUE 19 records: noisy-neighbor isolation + adapter hot-swap cost.
+
+    Isolation: one server with per-tenant admission — `noisy` capped at 2
+    outstanding, `victim` uncapped. The victim's steady sequential trickle
+    is timed twice per round: alone, then under a closed-loop noisy flood
+    (the flood mostly sheds `tenant_quota`; the admitted residue rides the
+    victim's batches). `value` is the best round's contended/alone p95
+    ratio. Mechanism gates hold everywhere — the flood really shed, every
+    noisy shed says `tenant_quota`, the victim never shed; the ratio gate
+    needs cores (flood threads and the decode worker fight for one core).
+
+    Swap cost: two LoRA servers, both alive, passes interleaved
+    on/off/on/off, min-of-repeats (drive_trace_overhead's methodology) —
+    one multiplexing three seeded adapters across resident slots (every
+    request pins its tenant's slot and the decode gathers per-row), one
+    plain (no slot axis, no registry). The p95 delta is the multiplexing
+    tax and must stay within 10% in smoke. A sequential churn phase then
+    rotates three adapters through TWO hot slots so every rotation pays a
+    real evict→spill→restore cycle, pricing the swap itself
+    (`swap_p50_ms` vs `resident_p50_ms`)."""
+    import os
+
+    import jax
+
+    rng = random.Random(seed)
+    vocab = MODEL_CFG["vocab_size"]
+
+    def body(req_seed: int, tenant: str = "", new: int = 8) -> dict:
+        b = {"tokens": [[rng.randrange(vocab) for _ in range(16)]],
+             "maxNewTokens": new, "temperature": 0.0, "seed": req_seed}
+        if tenant:
+            b["tenant"] = tenant
+        return b
+
+    def warm_post(url: str, b: dict):
+        try:
+            _post(url, b)
+        except urllib.error.HTTPError as e:
+            e.read()  # capped tenants legitimately shed warmup bursts
+
+    def warm(url: str, tenant: str = ""):
+        # pay every batch-bucket compile outside the timed windows: the
+        # contended/multiplexed passes coalesce up to max_batch rows
+        burst = 1
+        while burst <= max_batch:
+            bodies = [body(s, tenant=tenant) for s in range(burst)]
+            ws = [
+                threading.Thread(target=warm_post, args=(url, b), daemon=True)
+                for b in bodies
+            ]
+            for t in ws:
+                t.start()
+            for t in ws:
+                t.join()
+            burst *= 2
+
+    def timed_post(url: str, b: dict) -> float:
+        t0 = time.perf_counter()
+        _post(url, b)
+        return (time.perf_counter() - t0) * 1e3
+
+    # ---- record 1: tenant isolation under a noisy-neighbor flood ------
+    iso = build_server(
+        True, max_batch, max_wait_ms,
+        tenants=[{"name": "noisy", "max_outstanding": 2},
+                 {"name": "victim"}],
+    )
+    port = iso.start(port=0)
+    url = f"http://127.0.0.1:{port}/generate"
+    n_victim = max(8, requests // 2)
+    victim_bodies = [body(1000 + i, tenant="victim") for i in range(n_victim)]
+    noisy_shed = 0
+    noisy_ok = 0
+    noisy_reasons: dict[str, int] = {}
+    victim_shed = 0
+    victim_errors = 0
+    try:
+        warm(url, tenant="victim")
+        warm(url, tenant="noisy")
+
+        def victim_pass() -> list[float]:
+            # a shed or error against the UNCAPPED victim is an isolation
+            # break — count it (the mechanism gate requires zero) and keep
+            # driving so the record still reports the full picture
+            nonlocal victim_shed, victim_errors
+            lats = []
+            for b in victim_bodies:
+                t0 = time.perf_counter()
+                try:
+                    _post(url, b)
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    victim_shed += 1
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    victim_errors += 1
+            return lats
+
+        best = None
+        for _ in range(repeats):
+            alone = sorted(victim_pass())
+            # closed-loop flood: each thread hammers `noisy` until the
+            # victim pass drains; over-cap posts shed instantly (503)
+            stop = threading.Event()
+            lock = threading.Lock()
+
+            def flood(k: int):
+                nonlocal noisy_shed, noisy_ok
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        _post(url, body(5000 + k * 10000 + i,
+                                        tenant="noisy"))
+                        with lock:
+                            noisy_ok += 1
+                    except urllib.error.HTTPError as e:
+                        try:
+                            reason = json.loads(e.read()).get("reason")
+                        except Exception:  # noqa: BLE001
+                            reason = None
+                        with lock:
+                            noisy_shed += 1
+                            key = reason or f"http_{e.code}"
+                            noisy_reasons[key] = (
+                                noisy_reasons.get(key, 0) + 1
+                            )
+                    except Exception:  # noqa: BLE001 — flood is best-effort
+                        pass
+
+            floods = [
+                threading.Thread(target=flood, args=(k,), daemon=True)
+                for k in range(max(2, clients - 1))
+            ]
+            for t in floods:
+                t.start()
+            try:
+                contended = sorted(victim_pass())
+            finally:
+                stop.set()
+                for t in floods:
+                    t.join()
+            if not contended:
+                contended = alone
+            p95_a = quantile(alone, 0.95)
+            p95_c = quantile(contended, 0.95)
+            ratio = p95_c / p95_a if p95_a > 0 else None
+            if ratio is not None and (best is None or ratio < best[0]):
+                best = (ratio, alone, contended)
+    finally:
+        iso.stop()
+    ratio, alone, contended = best
+    cores = len(os.sched_getaffinity(0))
+    device = jax.devices()[0]
+    iso_rec = {
+        "metric": "serving_tenant_isolation_p95_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "victim_p50_alone_ms": round(quantile(alone, 0.5), 1),
+        "victim_p95_alone_ms": round(quantile(alone, 0.95), 1),
+        "victim_p50_contended_ms": round(quantile(contended, 0.5), 1),
+        "victim_p95_contended_ms": round(quantile(contended, 0.95), 1),
+        "victim_requests": n_victim,
+        "victim_shed": victim_shed,
+        "victim_errors": victim_errors,
+        "noisy_ok": noisy_ok,
+        "noisy_shed": noisy_shed,
+        "noisy_shed_reasons": noisy_reasons,
+        "noisy_max_outstanding": 2,
+        "flood_clients": max(2, clients - 1),
+        "repeats": repeats,
+        "host_cores": cores,
+        # flood threads, the victim's timing loop and the decode worker
+        # all fight for CPU on a 1-core host (see --interference) —
+        # report honestly, gate the ratio only where it can express
+        "gate_enforced": cores >= 2,
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+
+    # ---- record 2: adapter multiplexing tax + the price of one swap ---
+    adapters = {"acme": "seed:1", "beta": "seed:2", "gamma": "seed:3"}
+    multi = build_server(
+        True, max_batch, max_wait_ms, lora_rank=4,
+        adapters=adapters, adapter_slots=2,
+        tenants=[{"name": n, "adapter": n} for n in adapters],
+    )
+    solo = build_server(True, max_batch, max_wait_ms, lora_rank=4)
+    murl = f"http://127.0.0.1:{multi.start(port=0)}/generate"
+    surl = f"http://127.0.0.1:{solo.start(port=0)}/generate"
+    # the timed passes rotate the TWO resident tenants only, so they
+    # price the steady-state multiplexing tax (per-row slot gather +
+    # registry pin/unpin), not cold loads; the churn phase below brings
+    # in the third adapter and prices the swaps explicitly
+    hot = ("acme", "beta")
+    traffic = [(2000 + i, hot[i % len(hot)]) for i in range(requests)]
+
+    def one_pass(url: str, tenanted: bool) -> list[float]:
+        shards = [traffic[i::clients] for i in range(clients)]
+        lats: list[float] = []
+        lock = threading.Lock()
+
+        def client(shard):
+            for s, tenant in shard:
+                dt = timed_post(url, body(s, tenant=tenant if tenanted else ""))
+                with lock:
+                    lats.append(dt)
+
+        threads = [
+            threading.Thread(target=client, args=(sh,), daemon=True)
+            for sh in shards if sh
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats
+
+    try:
+        for tenant in hot:
+            warm(murl, tenant=tenant)
+        warm(surl)
+        best_p95: dict = {}
+        for _ in range(repeats):
+            for label, url, tenanted in (
+                ("multi", murl, True), ("solo", surl, False),
+            ):
+                lat = sorted(one_pass(url, tenanted))
+                p95 = quantile(lat, 0.95)
+                if label not in best_p95 or p95 < best_p95[label][0]:
+                    best_p95[label] = (p95, lat)
+
+        # churn: sequential rotation through all three adapters with only
+        # two hot slots — every third-tenant request evicts the LRU idle
+        # adapter (demoting its bytes to the spill tier) and, after the
+        # first cycle, restores the incoming one from spill
+        rotations = 2 if smoke else 4
+        swap_lat: list[float] = []
+        for r in range(rotations):
+            for tenant in ("gamma", "acme", "beta"):
+                swap_lat.append(
+                    timed_post(murl, body(7000 + r, tenant=tenant))
+                )
+        resident_lat = sorted(
+            timed_post(murl, body(8000 + i, tenant="beta"))
+            for i in range(len(swap_lat))
+        )
+        stats = json.loads(urllib.request.urlopen(
+            murl.replace("/generate", "/statsz"), timeout=30).read())
+    finally:
+        multi.stop()
+        solo.stop()
+    reg = stats["tenancy"]["adapters"]
+    p95_multi, _ = best_p95["multi"]
+    p95_solo, _ = best_p95["solo"]
+    overhead = (
+        (p95_multi - p95_solo) / p95_solo * 100 if p95_solo > 0 else 0.0
+    )
+    swap_sorted = sorted(swap_lat)
+    swap_rec = {
+        "metric": "serving_adapter_swap_overhead",
+        "value": round(overhead, 2),
+        "unit": "%",
+        "p95_multi_ms": round(p95_multi, 2),
+        "p95_solo_ms": round(p95_solo, 2),
+        "adapters": len(adapters),
+        "adapter_slots": 2,
+        "adapters_resident": reg["resident"],
+        "swap_p50_ms": round(quantile(swap_sorted, 0.5), 2),
+        "resident_p50_ms": round(quantile(resident_lat, 0.5), 2),
+        "swap_requests": len(swap_lat),
+        "swap_loads": reg["loads"],
+        "swap_evictions": reg["evictions"],
+        "swap_restores": reg["restores"],
+        "clients": clients,
+        "requests": requests,
+        "repeats": repeats,
+        "host_cores": cores,
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+    return [iso_rec, swap_rec]
+
+
 def serve_replica(port: int, max_batch: int, max_wait_ms: float) -> int:
     """`--serve-replica` self-mode: one replica process. Every replica
     builds the SAME model from PRNGKey(0), so responses are
@@ -1410,6 +1724,11 @@ def main(argv=None):
                          "prefix-affinity routing TTFT vs a forced "
                          "re-route, plus the eviction→spill→restore "
                          "cycle on the holder")
+    ap.add_argument("--tenants", action="store_true",
+                    help="run the ISSUE 19 multi-tenant records: victim-"
+                         "p95 isolation under a noisy-neighbor flood and "
+                         "the adapter hot-swap overhead vs a plain LoRA "
+                         "server")
     ap.add_argument("--replicas", type=int, default=2,
                     help="replica processes for --router")
     ap.add_argument("--serve-replica", action="store_true",
@@ -1447,6 +1766,34 @@ def main(argv=None):
             if overhead["value"] > 10.0:
                 ok = False
             if scale["gate_enforced"] and (scale["value"] or 0) < 1.7:
+                ok = False
+        return 0 if ok else 1
+
+    if args.tenants:
+        recs = drive_tenants(
+            args.clients, args.requests, args.max_batch, args.max_wait_ms,
+            args.repeats, args.seed, args.smoke,
+        )
+        for rec in recs:
+            print(json.dumps(rec), flush=True)
+        iso, swap = recs
+        # mechanism gates hold everywhere: the flood really shed, every
+        # noisy shed was attributed to the tenant's own quota, the
+        # uncapped victim never shed or errored, and the churn phase ran
+        # real evict→spill→restore cycles; timing gates only in smoke
+        # (and the isolation ratio only where the host has cores)
+        ok = (
+            iso["noisy_shed"] > 0
+            and set(iso["noisy_shed_reasons"]) == {"tenant_quota"}
+            and iso["victim_shed"] == 0
+            and iso["victim_errors"] == 0
+            and swap["swap_evictions"] >= 1
+            and swap["swap_restores"] >= 1
+        )
+        if args.smoke:
+            if swap["value"] > 10.0:
+                ok = False
+            if iso["gate_enforced"] and (iso["value"] or 0) > 3.0:
                 ok = False
         return 0 if ok else 1
 

@@ -1019,6 +1019,23 @@ def agent_drain(queues):
 @click.option("--spill-dir-bytes", default=None, type=int,
               help="byte budget for the on-disk spill tier (oldest "
                    "segments dropped first; requires --spill-dir)")
+@click.option("--adapter", "adapter_specs", multiple=True,
+              metavar="NAME=SOURCE",
+              help="register a named LoRA adapter to multiplex against "
+                   "the base model (repeatable): SOURCE is a .npz saved "
+                   "by serving.adapters.save_adapter, or seed:<int> for "
+                   "a synthetic adapter; requires a loraRank checkpoint")
+@click.option("--tenant-quota", "tenant_specs", multiple=True,
+              metavar="NAME=OUT:TOK:WEIGHT:ADAPTER",
+              help="per-tenant admission contract (repeatable): cap on "
+                   "outstanding requests, cap on outstanding tokens, "
+                   "fair-share weight, bound adapter name — any field "
+                   "may be left empty, e.g. acme=8::2.0:acme")
+@click.option("--adapter-slots", default=None, type=int,
+              help="device-resident adapter slots beyond the "
+                   "checkpoint's own slot 0 (default: one per adapter; "
+                   "fewer slots LRU-evict idle adapters through the "
+                   "spill tiers and restore them on request)")
 @click.option("--no-affinity", is_flag=True,
               help="router mode: disable prefix-affinity routing (warm "
                    "prompts no longer stick to the replica holding their "
@@ -1046,7 +1063,8 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           no_stream, speculate, draft_tokens, quantize, draft_model,
           adaptive_draft, kv_quant, chunked_prefill,
           no_chunked_prefill, prefill_chunk_tokens, max_step_tokens,
-          spill_ram_bytes, spill_dir, spill_dir_bytes, no_affinity,
+          spill_ram_bytes, spill_dir, spill_dir_bytes, adapter_specs,
+          tenant_specs, adapter_slots, no_affinity,
           no_trace, replicas, mesh_model, route, autoscale_max):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
@@ -1122,6 +1140,55 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         overrides["chunked_prefill"] = False
     if no_trace:
         overrides["trace"] = False
+    if adapter_specs:
+        from ..serving.tenancy import normalize_adapters
+
+        amap = {}
+        for spec in adapter_specs:
+            name, sep, src = spec.partition("=")
+            if not sep or not name.strip() or not src.strip():
+                raise click.ClickException(
+                    f"--adapter expects NAME=SOURCE, got {spec!r}"
+                )
+            amap[name.strip()] = src.strip()
+        try:
+            overrides["adapters"] = normalize_adapters(amap)
+        except ValueError as e:
+            raise click.ClickException(str(e))
+    if tenant_specs:
+        from ..serving.tenancy import normalize_tenants
+
+        rows = []
+        for spec in tenant_specs:
+            name, _, rest = spec.partition("=")
+            if not name.strip():
+                raise click.ClickException(
+                    f"--tenant-quota expects NAME=OUT:TOK:WEIGHT:ADAPTER "
+                    f"(fields optional), got {spec!r}"
+                )
+            fields = (rest.split(":") + [""] * 4)[:4]
+            row = {"name": name.strip()}
+            try:
+                if fields[0].strip():
+                    row["max_outstanding"] = int(fields[0])
+                if fields[1].strip():
+                    row["max_tokens"] = int(fields[1])
+                if fields[2].strip():
+                    row["weight"] = float(fields[2])
+            except ValueError:
+                raise click.ClickException(
+                    f"--tenant-quota {spec!r}: OUT/TOK are ints, WEIGHT "
+                    f"is a float"
+                )
+            if fields[3].strip():
+                row["adapter"] = fields[3].strip()
+            rows.append(row)
+        try:
+            overrides["tenants"] = normalize_tenants(rows)
+        except ValueError as e:
+            raise click.ClickException(str(e))
+    if adapter_slots is not None:
+        overrides["adapter_slots"] = adapter_slots
     for field, value in (
         ("max_batch", max_batch),
         ("max_wait_ms", max_wait_ms),
@@ -1207,6 +1274,7 @@ _SERVE_FLAG_SPELLING = {
     "max_step_tokens": "--max-step-tokens",
     "spill_ram_bytes": "--spill-ram-bytes",
     "spill_dir_bytes": "--spill-dir-bytes",
+    "adapter_slots": "--adapter-slots",
 }
 
 
@@ -1244,6 +1312,20 @@ def _serve_child_argv(uid, port, mesh_axes, overrides, expected_devices):
             # each replica child gets its own segment namespace: two
             # processes writing one spill dir would collide on seq names
             argv += ["--spill-dir", str(Path(value) / f"r{port}")]
+        elif field == "adapters":
+            for name, src in value:
+                argv += ["--adapter", f"{name}={src}"]
+        elif field == "tenants":
+            for pairs in value:
+                d = dict(pairs)
+                out = d.get("max_outstanding")
+                tok = d.get("max_tokens")
+                argv += ["--tenant-quota",
+                         f"{d['name']}"
+                         f"={'' if out is None else out}"
+                         f":{'' if tok is None else tok}"
+                         f":{d.get('weight', 1.0)}"
+                         f":{d.get('adapter', '')}"]
         elif field in _SERVE_FLAG_SPELLING:
             argv += [_SERVE_FLAG_SPELLING[field], str(value)]
     return argv

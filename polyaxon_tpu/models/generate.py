@@ -69,6 +69,8 @@ def generate(
     # can take the seed as a runtime argument instead of recompiling per seed),
     # or a [B] array of per-row seeds (one independent stream per batch row)
     prompt_lengths=None,  # [B] true lengths of a LEFT-padded prompt batch
+    adapter_ix=None,  # [B] per-row adapter slot (ISSUE 19): mixes tenants
+    # in one batch on a slot-stacked model; None = base adapter (slot 0)
 ) -> jnp.ndarray:
     """Generate `max_new_tokens` continuations of `prompt` [B, P] (int32).
 
@@ -102,6 +104,8 @@ def generate(
     if prompt_lengths is not None:
         pad = (P - jnp.asarray(prompt_lengths, jnp.int32)).astype(jnp.int32)
         pad_kw = {"pad": pad}  # only modules on the bucketed path take it
+    if adapter_ix is not None:
+        pad_kw["adapter_ix"] = jnp.asarray(adapter_ix, jnp.int32)
 
     # cache creation pass: one dummy mutable apply materializes zeroed
     # cache variables (flax recipe — variables appear on first mutable use)
@@ -206,6 +210,15 @@ def _row_rngs(row_keys, g):
     return jax.vmap(lambda k: jax.random.fold_in(k, g))(row_keys)
 
 
+def _adapter_kw(adapter_ix):
+    """kwargs for module.apply: the per-row adapter slots (ISSUE 19) only
+    enter the call when a caller passes them, so every adapter-free
+    program keeps its exact legacy trace."""
+    if adapter_ix is None:
+        return {}
+    return {"adapter_ix": jnp.asarray(adapter_ix, jnp.int32)}
+
+
 def make_paged_cache(module, params, layout: PagedKVLayout):
     """Materialize the pool-shaped cache pytree (zeros) via the standard
     creation apply. Leaves are [pool_pages, page_tokens, nkv, hd] (with a
@@ -236,6 +249,7 @@ def paged_prefill(
     temperature: float,
     top_k: Optional[int],
     seeds,
+    adapter_ix=None,
 ) -> tuple:
     """Prefill `prompt` [B, S] (LEFT-padded suffixes when a shared prefix
     of `prefix_len` tokens is already in the pool) through the page
@@ -252,6 +266,7 @@ def paged_prefill(
         pos=jnp.asarray(prefix_len, jnp.int32),
         kv_layout=kv_layout,
         prefix_len=prefix_len,
+        **_adapter_kw(adapter_ix),
     )
     row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
     first = _sample_rows(
@@ -281,6 +296,7 @@ def paged_decode_chunk(
     top_k: Optional[int],
     eos_id: Optional[int],
     seeds,
+    adapter_ix=None,
 ) -> tuple:
     """Run `steps` cached decode steps through the page table.
 
@@ -307,6 +323,7 @@ def paged_decode_chunk(
             pos=pos + i,
             kv_layout=kv_layout,
             prefix_len=prefix_len,
+            **_adapter_kw(adapter_ix),
         )
         nxt = _sample_rows(
             logits[:, -1].astype(jnp.float32),
@@ -340,12 +357,12 @@ def jit_paged_prefill(
     in place, never duplicated (on backends without donation support,
     e.g. CPU, jax falls back to a copy with a warning)."""
 
-    def run(params, cache, prompt, pad, pages, seeds):
+    def run(params, cache, prompt, pad, pages, seeds, adapter_ix=None):
         return paged_prefill(
             module, params, cache, prompt,
             pad=pad, pages=pages, kv_layout=kv_layout,
             prefix_len=prefix_len, temperature=temperature, top_k=top_k,
-            seeds=seeds,
+            seeds=seeds, adapter_ix=adapter_ix,
         )
 
     return jax.jit(run, donate_argnums=(1,))
@@ -366,13 +383,14 @@ def jit_paged_chunk(
     DONATED (see jit_paged_prefill); pos/start_g are traced scalars so
     successive chunks reuse one compile."""
 
-    def run(params, cache, tok, done, pad, pages, seeds, pos, start_g):
+    def run(params, cache, tok, done, pad, pages, seeds, pos, start_g,
+            adapter_ix=None):
         return paged_decode_chunk(
             module, params, cache, tok, done,
             steps=steps, pos=pos, start_g=start_g, pad=pad, pages=pages,
             kv_layout=kv_layout, prefix_len=prefix_len,
             temperature=temperature, top_k=top_k, eos_id=eos_id,
-            seeds=seeds,
+            seeds=seeds, adapter_ix=adapter_ix,
         )
 
     return jax.jit(run, donate_argnums=(1,))
@@ -417,6 +435,7 @@ def paged_prefill_chunk(
     top_k: Optional[int] = None,
     seeds=None,
     final: bool = False,
+    adapter_ix=None,
 ) -> tuple:
     """Write one prefill slice `chunk` [B, C] (columns [pos-prefix, ...)
     of the row's LEFT-padded suffix) into the page tables at slots
@@ -435,6 +454,7 @@ def paged_prefill_chunk(
         pos=jnp.asarray(pos, jnp.int32),
         kv_layout=kv_layout,
         prefix_lens=jnp.asarray(prefix_lens, jnp.int32),
+        **_adapter_kw(adapter_ix),
     )
     if not final:
         _, vars1 = module.apply(
@@ -471,12 +491,14 @@ def jit_paged_prefill_chunk(
     vector, so every slice of every row — whatever its cached-prefix
     width — reuses one compile per (B, C, n_pages) shape."""
 
-    def run(params, cache, chunk, pad, prefix_lens, pages, seeds, pos):
+    def run(params, cache, chunk, pad, prefix_lens, pages, seeds, pos,
+            adapter_ix=None):
         return paged_prefill_chunk(
             module, params, cache, chunk,
             pad=pad, pages=pages, kv_layout=kv_layout,
             prefix_lens=prefix_lens, pos=pos,
             temperature=temperature, top_k=top_k, seeds=seeds, final=final,
+            adapter_ix=adapter_ix,
         )
 
     return jax.jit(run, donate_argnums=(1,))
@@ -499,6 +521,7 @@ def paged_step(
     temperature: float,
     top_k: Optional[int],
     eos_id: Optional[int],
+    adapter_ix=None,
 ) -> tuple:
     """ONE decode step for a continuous batch: feed `tok` [B] at per-row
     frontiers `pos` [B] and sample each row's next token at its own
@@ -518,6 +541,7 @@ def paged_step(
         pos=jnp.asarray(pos, jnp.int32),
         kv_layout=kv_layout,
         prefix_lens=jnp.asarray(prefix_lens, jnp.int32),
+        **_adapter_kw(adapter_ix),
     )
     row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
     rngs = jax.vmap(jax.random.fold_in)(row_keys, jnp.asarray(g, jnp.int32))
@@ -544,12 +568,14 @@ def jit_paged_step(
     compile per (B, n_pages, sampling) signature serves the whole mixed
     step stream."""
 
-    def run(params, cache, tok, done, pad, prefix_lens, pages, seeds, pos, g):
+    def run(params, cache, tok, done, pad, prefix_lens, pages, seeds, pos, g,
+            adapter_ix=None):
         return paged_step(
             module, params, cache, tok, done,
             pad=pad, prefix_lens=prefix_lens, pages=pages,
             kv_layout=kv_layout, pos=pos, g=g, seeds=seeds,
             temperature=temperature, top_k=top_k, eos_id=eos_id,
+            adapter_ix=adapter_ix,
         )
 
     return jax.jit(run, donate_argnums=(1,))

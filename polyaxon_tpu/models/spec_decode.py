@@ -156,9 +156,9 @@ def jit_spec_prefill(module, *, temperature: float, top_k: Optional[int]):
     pad, seeds) → (cache, first [B]). Identical math to generate()'s
     prefill — creation apply, one batched prompt forward, generation
     index 0 sampled from the last-position logits."""
-    from .generate import _row_rngs
+    from .generate import _adapter_kw, _row_rngs
 
-    def run(params, prompt, pad, seeds):
+    def run(params, prompt, pad, seeds, adapter_ix=None):
         B = prompt.shape[0]
         _, init_vars = module.apply(
             {"params": params},
@@ -174,6 +174,7 @@ def jit_spec_prefill(module, *, temperature: float, top_k: Optional[int]):
             decode=True,
             mutable=["cache"],
             pad=pad,
+            **_adapter_kw(adapter_ix),
         )
         row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
         first = _sample_rows(
@@ -200,7 +201,10 @@ def jit_spec_verify(
     vectors, so every window of every group reuses one compile per
     (batch, K+1) shape."""
 
-    def run(params, cache, fed, done, pad, seeds, pos, start_g):
+    def run(params, cache, fed, done, pad, seeds, pos, start_g,
+            adapter_ix=None):
+        from .generate import _adapter_kw
+
         row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
         logits, vars1 = module.apply(
             {"params": params, "cache": cache},
@@ -210,6 +214,7 @@ def jit_spec_verify(
             mutable=["cache"],
             pad=pad,
             pos=jnp.asarray(pos, jnp.int32),
+            **_adapter_kw(adapter_ix),
         )
         targets, accept = _verify_targets(
             logits, fed, row_keys, jnp.asarray(start_g, jnp.int32), done,
@@ -235,7 +240,10 @@ def jit_spec_verify_paged(
     DONATED and written in place through the page tables; writes past a
     row's table span (rejected-tail overflow) drop in the scatter."""
 
-    def run(params, cache, fed, done, pad, pages, seeds, pos, start_g):
+    def run(params, cache, fed, done, pad, pages, seeds, pos, start_g,
+            adapter_ix=None):
+        from .generate import _adapter_kw
+
         row_keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.int32))
         logits, vars1 = module.apply(
             {"params": params, "cache": cache},
@@ -248,6 +256,7 @@ def jit_spec_verify_paged(
             pos=jnp.asarray(pos, jnp.int32),
             kv_layout=kv_layout,
             prefix_len=prefix_len,
+            **_adapter_kw(adapter_ix),
         )
         targets, accept = _verify_targets(
             logits, fed, row_keys, jnp.asarray(start_g, jnp.int32), done,
@@ -342,6 +351,7 @@ def spec_generate(
     stats: Optional[dict] = None,  # accumulates proposed/accepted/rollback
     drafter=None,  # models.draft.ModelDrafter — replaces the n-gram index
     controller=None,  # adaptive-K hook: window_k()/observe()/tick_plain()
+    adapter_ix=None,  # [B] per-row adapter slot (ISSUE 19); None = slot 0
 ) -> jnp.ndarray:
     """Speculative drop-in for generate() on the dense cache: same
     [B, P + max_new_tokens] result, byte-identical per row, usually far
@@ -398,7 +408,13 @@ def spec_generate(
             module, temperature=temperature, top_k=top_k, eos_id=eos_id
         )
 
-    cache, first = prefill_fn(params, prompt, pad, seeds)
+    if adapter_ix is not None:
+        adapter_ix = jnp.asarray(adapter_ix, jnp.int32)
+    cache, first = (
+        prefill_fn(params, prompt, pad, seeds)
+        if adapter_ix is None
+        else prefill_fn(params, prompt, pad, seeds, adapter_ix)
+    )
     first = np.asarray(first)
     prompt_np = np.asarray(prompt)
 
@@ -442,11 +458,14 @@ def spec_generate(
                         if remaining[b] > 0
                         else tok[b]
                     )
-        cache, targets, accept = verify_fn(
+        verify_args = (
             params, cache, jnp.asarray(fed), jnp.asarray(done), pad,
             seeds, jnp.asarray(pos, jnp.int32),
             jnp.asarray(start_g, jnp.int32),
         )
+        if adapter_ix is not None:
+            verify_args = verify_args + (adapter_ix,)
+        cache, targets, accept = verify_fn(*verify_args)
         committed, done, remaining, eos_hit, delta = commit_window(
             fed, targets, accept, remaining, done, eos_id
         )

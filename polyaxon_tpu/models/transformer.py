@@ -58,6 +58,15 @@ class TransformerConfig:
     # per-output-channel scale, dequant-free mixed matmul). Set by
     # quant.quantize_module at serving load — not a training config.
     quant: str = "none"  # none | int8
+    # multi-tenant adapter multiplexing (ISSUE 19): > 0 stacks every
+    # LoRA A/B pair to [slots, ...] and each projection gathers a
+    # PER-ROW adapter by index (`adapter_ix` [B]), so one coalesced
+    # decode batch mixes tenants. Slot 0 is the checkpoint's own
+    # resident adapter (the serving layer broadcasts the restored
+    # lora_a/lora_b there and zero-fills slots 1..N for the
+    # AdapterRegistry to hot-swap). Set by serving stack-on-load
+    # (serving/adapters.stack_adapter_params) — not a training config.
+    adapter_slots: int = 0
     tie_embeddings: bool = False
     scan_layers: bool = False
     # MoE: replace the dense FFN with n_experts switch-routed experts
@@ -142,15 +151,25 @@ class LoRADense(nn.Module):
     base kernel rides the same dequant-free mixed matmul as Int8Dense —
     int8 kernel + per-output-channel f32 scale — while the adapter
     deltas stay at checkpoint precision: the base carries the bulk of
-    the HBM traffic, the rank-r adapters carry the tenant signal."""
+    the HBM traffic, the rank-r adapters carry the tenant signal.
+
+    With slots > 0 (multi-tenant serving, ISSUE 19) the A/B pair is
+    stacked to [slots, ...] and each batch row gathers ITS adapter by
+    `adapter_ix` — one matmul group serves many tenants. The gathered
+    weights are value-identical regardless of which slot a tenant's
+    adapter happens to occupy, so a mixed-tenant batch row computes the
+    same bytes as a solo server holding that adapter alone. adapter_ix
+    defaults to slot 0 for every row (the base/resident adapter), which
+    is also what pad rows ride."""
 
     features: int
     rank: int
     alpha: float
     quant: str = "none"
+    slots: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ix=None):
         in_dim = x.shape[-1]
         if self.quant == "int8":
             kernel = self.param(
@@ -173,21 +192,52 @@ class LoRADense(nn.Module):
                 (in_dim, self.features),
             )
             y = x @ kernel.astype(x.dtype)
-        a = self.param("lora_a", nn.initializers.normal(1e-2), (in_dim, self.rank))
-        b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features))
-        delta = (x @ a.astype(x.dtype)) @ b.astype(x.dtype)
+        if self.slots > 0:
+            a = self.param(
+                "lora_a", nn.initializers.normal(1e-2),
+                (self.slots, in_dim, self.rank),
+            )
+            b = self.param(
+                "lora_b", nn.initializers.zeros,
+                (self.slots, self.rank, self.features),
+            )
+            ix = (
+                jnp.zeros((x.shape[0],), jnp.int32)
+                if adapter_ix is None
+                else jnp.asarray(adapter_ix, jnp.int32)
+            )
+            # per-row gather of the stacked adapters: rank-r slivers, so
+            # the gathered copies are activation-sized, not weight-sized
+            aa = jnp.take(a.astype(x.dtype), ix, axis=0)  # [B, in, r]
+            bb = jnp.take(b.astype(x.dtype), ix, axis=0)  # [B, r, out]
+            delta = jnp.einsum("b...i,bir->b...r", x, aa)
+            delta = jnp.einsum("b...r,bro->b...o", delta, bb)
+        else:
+            a = self.param("lora_a", nn.initializers.normal(1e-2), (in_dim, self.rank))
+            b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features))
+            delta = (x @ a.astype(x.dtype)) @ b.astype(x.dtype)
         return y + (self.alpha / self.rank) * delta
 
 
 def _proj(cfg: TransformerConfig, features: int, name: str):
     if cfg.lora_rank > 0 and (not cfg.lora_targets or name in cfg.lora_targets):
         return LoRADense(features, rank=cfg.lora_rank, alpha=cfg.lora_alpha,
-                         quant=cfg.quant, name=name)
+                         quant=cfg.quant, slots=cfg.adapter_slots, name=name)
     if cfg.quant == "int8":
         from .quant import Int8Dense
 
         return Int8Dense(features, name=name)
     return nn.Dense(features, use_bias=False, name=name)
+
+
+def _run_proj(cfg: TransformerConfig, features: int, name: str, x,
+              adapter_ix=None):
+    """Apply a projection, routing the per-row adapter index only to
+    LoRADense — nn.Dense/Int8Dense signatures stay untouched."""
+    mod = _proj(cfg, features, name)
+    if isinstance(mod, LoRADense):
+        return mod(x, adapter_ix)
+    return mod(x)
 
 
 class Attention(nn.Module):
@@ -211,15 +261,17 @@ class Attention(nn.Module):
         # scheduler (ISSUE 14) packs rows with DIFFERENT cached-prefix
         # lengths into one compiled program, so the mask's prefix boundary
         # must be a runtime argument there; overrides `prefix_len`
+        adapter_ix=None,  # traced [B] per-row adapter slot (ISSUE 19);
+        # None = slot 0 (the base/resident adapter) for every row
     ):
         cfg = self.cfg
         B, S, _ = x.shape
         hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
         from ..parallel.sharding import constrain
 
-        q = _proj(cfg, nh * hd, "q_proj")(x).reshape(B, S, nh, hd)
-        k = _proj(cfg, nkv * hd, "k_proj")(x).reshape(B, S, nkv, hd)
-        v = _proj(cfg, nkv * hd, "v_proj")(x).reshape(B, S, nkv, hd)
+        q = _run_proj(cfg, nh * hd, "q_proj", x, adapter_ix).reshape(B, S, nh, hd)
+        k = _run_proj(cfg, nkv * hd, "k_proj", x, adapter_ix).reshape(B, S, nkv, hd)
+        v = _run_proj(cfg, nkv * hd, "v_proj", x, adapter_ix).reshape(B, S, nkv, hd)
         # heads on the model axis (column-parallel QKV output)
         q = constrain(q, BATCH, "context", "model", None)
         k = constrain(k, BATCH, "context", "model", None)
@@ -474,7 +526,7 @@ class Attention(nn.Module):
                     probs.reshape(B, nkv, G, S, win),
                     v_all,
                 ).reshape(B, S, nh * hd)
-                return _proj(cfg, cfg.dim, "o_proj")(out)
+                return _run_proj(cfg, cfg.dim, "o_proj", out, adapter_ix)
             # cache creation pass (first mutable apply): fall through to the
             # ordinary full-sequence attention so output shapes are normal
 
@@ -493,7 +545,7 @@ class Attention(nn.Module):
             block_kv=cfg.attention_block,
         )
         out = constrain(out.reshape(B, S, nh * hd), BATCH, "context", "model")
-        return _proj(cfg, cfg.dim, "o_proj")(out)
+        return _run_proj(cfg, cfg.dim, "o_proj", out, adapter_ix)
 
 
 # logical axes the batch dim may be split over: training meshes carry
@@ -506,16 +558,16 @@ class FeedForward(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ix=None):
         from ..parallel.sharding import constrain
 
         cfg = self.cfg
-        gate = _proj(cfg, cfg.ffn_dim, "gate_proj")(x)
-        up = _proj(cfg, cfg.ffn_dim, "up_proj")(x)
+        gate = _run_proj(cfg, cfg.ffn_dim, "gate_proj", x, adapter_ix)
+        up = _run_proj(cfg, cfg.ffn_dim, "up_proj", x, adapter_ix)
         # column-parallel output: hidden dim lives on the model axis until
         # the row-parallel down projection reduces it
         h = constrain(nn.silu(gate) * up, BATCH, "context", "model")
-        return _proj(cfg, cfg.dim, "down_proj")(h)
+        return _run_proj(cfg, cfg.dim, "down_proj", h, adapter_ix)
 
 
 class Block(nn.Module):
@@ -529,7 +581,8 @@ class Block(nn.Module):
     prefix_len: int = 0
 
     @nn.compact
-    def __call__(self, x, pad=None, pages=None, pos=None, prefix_lens=None):
+    def __call__(self, x, pad=None, pages=None, pos=None, prefix_lens=None,
+                 adapter_ix=None):
         from ..parallel.sharding import constrain
 
         cfg = self.cfg
@@ -544,6 +597,7 @@ class Block(nn.Module):
             kv_layout=self.kv_layout,
             prefix_len=self.prefix_len,
             prefix_lens=prefix_lens,
+            adapter_ix=adapter_ix,
         )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
@@ -560,7 +614,7 @@ class Block(nn.Module):
             )(RMSNorm(cfg.norm_eps, name="mlp_norm")(x), train=self.train)
         else:
             h = FeedForward(cfg, name="mlp")(
-                RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+                RMSNorm(cfg.norm_eps, name="mlp_norm")(x), adapter_ix
             )
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.train)(h)
@@ -590,6 +644,17 @@ class _ScanBlock(nn.Module):
             name="block",
         )
         if isinstance(carry, tuple):
+            if len(carry) == 6:
+                # multi-tenant decode (ISSUE 19): the per-row adapter
+                # slots ride the carry next to pad/pages/pos/prefix_lens
+                x, pad, pages, pos, prefix_lens, adapter_ix = carry
+                return (
+                    block(
+                        x, pad=pad, pages=pages, pos=pos,
+                        prefix_lens=prefix_lens, adapter_ix=adapter_ix,
+                    ),
+                    pad, pages, pos, prefix_lens, adapter_ix,
+                ), None
             if len(carry) == 5:
                 x, pad, pages, pos, prefix_lens = carry
                 return (
@@ -685,8 +750,20 @@ class Transformer(nn.Module):
         prefix_len: int = 0,  # static shared-prefix width (paged path)
         prefix_lens=None,  # traced [B] per-row prefix widths (step
         # scheduler mixed-prefix programs); overrides prefix_len
+        adapter_ix=None,  # traced [B] per-row adapter slot (ISSUE 19):
+        # gathers each row's stacked lora_a/lora_b so one batch mixes
+        # tenants; None = slot 0 (base/resident adapter) for all rows
     ):
         cfg = self.cfg
+        if adapter_ix is not None and cfg.adapter_slots <= 0:
+            raise ValueError(
+                "adapter_ix needs a slot-stacked model (adapter_slots > 0 "
+                "— serving/adapters.stack_adapter_params)"
+            )
+        if adapter_ix is not None and cfg.pipeline_stages > 1:
+            raise ValueError(
+                "adapter_ix is not supported with pipeline_stages > 1"
+            )
         if decode and cfg.pipeline_stages > 1:
             raise ValueError(
                 "KV-cache decode is not supported with pipeline_stages > 1 "
@@ -735,7 +812,14 @@ class Transformer(nn.Module):
                 cfg, train, decode,
                 kv_layout=kv_layout, prefix_len=prefix_len, name="layers",
             )
-            if prefix_lens is not None:
+            if adapter_ix is not None:
+                # 6-tuple carry: per-row adapter slots alongside the other
+                # traced row arrays (tenant-mixed programs only, so every
+                # legacy carry keeps its compiled identity)
+                (x, _, _, _, _, _), _ = layers(
+                    (x, pad, pages, pos, prefix_lens, adapter_ix), None
+                )
+            elif prefix_lens is not None:
                 # 5-tuple carry: the traced per-row prefix widths ride
                 # alongside pad/pages/pos (step-scheduler programs only,
                 # so the legacy 4-tuple carry keeps its compiled identity)
@@ -756,7 +840,8 @@ class Transformer(nn.Module):
                     cfg, train, decode,
                     kv_layout=kv_layout, prefix_len=prefix_len,
                     name=f"layer_{i}",
-                )(x, pad=pad, pages=pages, pos=pos, prefix_lens=prefix_lens)
+                )(x, pad=pad, pages=pages, pos=pos, prefix_lens=prefix_lens,
+                  adapter_ix=adapter_ix)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_features:
             # fused-loss path: the caller computes head+loss from features;
@@ -878,6 +963,14 @@ def build_transformer(config: dict) -> ModelBundle:
     rules = SCAN_RULES if cfg.scan_layers else TRANSFORMER_RULES
     if cfg.pipeline_stages > 1:
         rules = PIPELINE_RULES + TRANSFORMER_RULES
+    if cfg.adapter_slots > 0:
+        # slot-stacked adapters gain a leading [slots] axis: replicate it
+        # (each gather pulls one rank-r sliver; sharding the slot axis
+        # would turn every per-row gather into a collective)
+        rules = tuple(
+            (pat, (None, *axes)) if "lora_" in pat else (pat, axes)
+            for pat, axes in rules
+        )
     if cfg.n_experts > 0:
         from .moe import MOE_RULES
 

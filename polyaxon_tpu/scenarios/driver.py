@@ -50,6 +50,7 @@ class Outcome:
     tokens: int = 0  # generated tokens delivered to this client
     disconnected: bool = False  # the scripted disconnect was executed
     rid_echoed: bool = False  # X-Request-Id came back on the response
+    tenant: str = "default"  # the record's tenant (ISSUE 19)
 
 
 @dataclasses.dataclass
@@ -87,6 +88,38 @@ class ReplayReport:
         shed = by["shed_503"] + by["deadline_504"]
         lat.sort()
         ttft.sort()
+        # per-tenant breakdown (ISSUE 19) — only when the trace actually
+        # names tenants, so classic single-tenant summaries stay stable
+        tstats: dict[str, dict] = {}
+        for o in self.outcomes:
+            st = tstats.setdefault(o.tenant, {
+                "offered": 0, "ok": 0, "shed": 0, "error": 0,
+                "shed_reasons": {}, "_lat": [],
+            })
+            st["offered"] += 1
+            if o.disconnected or o.status == 200:
+                st["ok"] += 1
+                if o.latency_ms is not None and o.status == 200:
+                    st["_lat"].append(o.latency_ms)
+            elif o.status in (503, 504):
+                st["shed"] += 1
+                if o.reason:
+                    st["shed_reasons"][o.reason] = (
+                        st["shed_reasons"].get(o.reason, 0) + 1
+                    )
+            elif o.status != 0:
+                st["error"] += 1
+        by_tenant = {}
+        if set(tstats) - {"default"}:
+            for t, st in sorted(tstats.items()):
+                tl = sorted(st.pop("_lat"))
+                by_tenant[t] = {
+                    **st,
+                    "latency_ms": {
+                        "p50": quantile(tl, 0.5),
+                        "p99": quantile(tl, 0.99),
+                    },
+                }
         return {
             "mode": "real",
             "offered": self.offered,
@@ -104,6 +137,7 @@ class ReplayReport:
                 "p50": quantile(ttft, 0.5),
                 "p99": quantile(ttft, 0.99),
             },
+            "by_tenant": by_tenant,
             "duration_s": round(self.duration_s, 3),
         }
 
@@ -190,6 +224,7 @@ def replay(
     time_scale: float = 1.0,
     timeout_s: float = 60.0,
     rid_prefix: str = "scn",
+    tenancy: bool = False,
 ) -> ReplayReport:
     """Replay a trace open-loop against `base_url` (a router or replica).
 
@@ -206,11 +241,11 @@ def replay(
 
     def fire(rec: TraceRequest) -> None:
         rid = f"{rid_prefix}-{rec.i:07d}"
-        o = Outcome(i=rec.i, rid=rid)
+        o = Outcome(i=rec.i, rid=rid, tenant=rec.tenant or "default")
         delay = epoch + rec.at / max(1e-9, time_scale) - _now()
         if delay > 0:
             pacer.wait(delay)
-        body = body_for(rec, vocab_size)
+        body = body_for(rec, vocab_size, tenancy=tenancy)
         t0 = _now()
         try:
             if rec.disconnect_after_ms is not None:

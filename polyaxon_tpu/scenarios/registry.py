@@ -70,6 +70,14 @@ class Assertions:
     # from below (a floor that must hold across the whole story).
     max_metric_trend: Optional[dict] = None
     min_metric_floor: Optional[dict] = None
+    # tenancy predicates (ISSUE 19), read off the summary's `by_tenant`
+    # block. `min_shed_share` binds tenant → min fraction of ALL sheds
+    # attributed to that tenant (the noisy neighbor must absorb its own
+    # flood — and implicitly, nobody else's sheds may grow). `tenant_p99_ms`
+    # binds tenant → p99 latency ceiling (the victim's tail must stay
+    # flat under the storm).
+    min_shed_share: Optional[dict] = None
+    tenant_p99_ms: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +92,9 @@ class Scenario:
     chaos: Optional[str] = None  # "replica_kill" | None
     twin_config: dict = dataclasses.field(default_factory=dict)
     twin_only: bool = False
+    # stamp each record's tenant into its request body (requires the
+    # rig's servers to declare those tenants via serving_overrides)
+    tenancy: bool = False
     seed: int = 0
     time_scale: float = 1.0
 
@@ -211,6 +222,41 @@ _register(Scenario(
 ))
 
 _register(Scenario(
+    name="tenant_storm",
+    description="A noisy tenant floods at ~10x the victim's rate into "
+                "per-tenant admission caps — the flood sheds as "
+                "tenant_quota against the noisy tenant alone while the "
+                "victim tenant's steady trickle completes with a flat "
+                "tail (the noisy-neighbor isolation story).",
+    generator="tenant_storm",
+    params=dict(n=240, noisy_frac=0.85, victim_rps=4.0, noisy_rps=40.0,
+                prompt_len=16, max_new=8),
+    smoke_params=dict(n=48, noisy_frac=0.75, victim_rps=1.5,
+                      noisy_rps=25.0, prompt_len=16, max_new=8),
+    tenancy=True,
+    # the rig's replicas each cap the noisy tenant at 3 outstanding
+    # rows; the victim rides uncapped (weights only matter under
+    # contention for the batch head, which this trace never reaches)
+    serving_overrides=dict(tenants=[
+        dict(name="noisy", max_outstanding=3),
+        dict(name="victim"),
+    ]),
+    assertions=Assertions(
+        max_shed_rate=0.9, max_error_rate=0.0, min_completed=8,
+        min_shed_share={"noisy": 0.95},
+        # generous ceiling for the same reason diurnal_soak's p99 is:
+        # the 1-core CI box's compile head is host speed, not isolation
+        tenant_p99_ms={"victim": 45_000.0},
+    ),
+    # the twin's measured costs are steady-state (no compile head), so
+    # at trace rates the default batched service would never accumulate
+    # outstanding rows — serial batches and a tight fleet-wide cap
+    # reproduce the contention the real rig reaches through its much
+    # slower cold service
+    twin_config=dict(tenants={"noisy": 1}, max_batch=1),
+))
+
+_register(Scenario(
     name="million_user_soak",
     description="A million-request, two-hour diurnal soak through the "
                 "discrete-event twin — seconds of wall time on the CI "
@@ -275,6 +321,13 @@ def build_rig(replicas: int = 2, overrides: Optional[dict] = None,
         jnp.zeros((1, 8), jnp.int32),
         train=False,
     )["params"]
+    overrides = dict(overrides or {})
+    if overrides.get("tenants"):
+        # scenario defs carry tenants as plain dicts; the config wants
+        # the canonical pair-tuples
+        from ..serving.tenancy import normalize_tenants
+
+        overrides["tenants"] = normalize_tenants(overrides["tenants"])
     cfg = ServingConfig(**{
         "max_batch": 4, "max_wait_ms": 2.0, "kv_page_tokens": 8,
         "kv_pool_pages": 96, "stream_chunk_tokens": 4,
@@ -284,7 +337,7 @@ def build_rig(replicas: int = 2, overrides: Optional[dict] = None,
         # serving_kv_pages_prefix_held gauge instead
         "prefix_cache": False,
         "request_timeout_s": 60.0,
-        **(overrides or {}),
+        **overrides,
     })
     if slos is None:
         slos = [{"name": "availability", "kind": "availability",
@@ -400,6 +453,23 @@ def evaluate(a: Assertions, summary: dict, metrics: dict,
             f"{round(first, 4)} / {round(second, 4)})",
         )
 
+    by_tenant = summary.get("by_tenant") or {}
+    for tenant, share in sorted((a.min_shed_share or {}).items()):
+        total = summary.get("shed", 0) or sum(
+            st.get("shed", 0) for st in by_tenant.values()
+        )
+        mine = by_tenant.get(tenant, {}).get("shed", 0)
+        frac = (mine / total) if total else None
+        check(
+            f"min_shed_share:{tenant}",
+            frac is not None and frac >= share,
+            f"shed_share={None if frac is None else round(frac, 4)} "
+            f">= {share} ({mine}/{total} sheds on {tenant!r})",
+        )
+    for tenant, bound in sorted((a.tenant_p99_ms or {}).items()):
+        p99 = by_tenant.get(tenant, {}).get("latency_ms", {}).get("p99")
+        check(f"tenant_p99_ms:{tenant}", p99 is None or p99 <= bound,
+              f"p99={p99} <= {bound} for {tenant!r}")
     if a.zero_hung:
         check("zero_hung", summary["hung"] == 0,
               f"hung={summary['hung']}")
@@ -560,6 +630,7 @@ def run_real(scn: Scenario, *, smoke: bool = False,
             time_scale=time_scale or scn.time_scale,
             timeout_s=60.0,
             rid_prefix=scn.name,
+            tenancy=scn.tenancy,
         )
         stop_chaos.set()
         texts = _wait_drained(rig)

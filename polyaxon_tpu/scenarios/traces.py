@@ -92,8 +92,14 @@ def prompt_tokens(rec: TraceRequest, vocab_size: int) -> list[int]:
     return out
 
 
-def body_for(rec: TraceRequest, vocab_size: int) -> dict:
-    """The record as a POST /generate body (tokens derived on demand)."""
+def body_for(rec: TraceRequest, vocab_size: int, *,
+             tenancy: bool = False) -> dict:
+    """The record as a POST /generate body (tokens derived on demand).
+
+    `tenancy=True` stamps the record's tenant into the body — opt-in,
+    because a server WITHOUT tenants configured rejects named tenants
+    (400), and the historical generators label records with synthetic
+    tenant names the classic rigs never declare."""
     body = {
         "tokens": [prompt_tokens(rec, vocab_size)],
         "maxNewTokens": int(rec.max_new),
@@ -104,6 +110,8 @@ def body_for(rec: TraceRequest, vocab_size: int) -> dict:
         body["topK"] = int(rec.top_k)
     if rec.deadline_ms is not None:
         body["deadlineMs"] = float(rec.deadline_ms)
+    if tenancy and rec.tenant:
+        body["tenant"] = rec.tenant
     return body
 
 
@@ -287,6 +295,37 @@ def disconnect_storm(seed: int, *, n: int = 200, rps: float = 15.0,
         )
 
 
+def tenant_storm(seed: int, *, n: int = 400, noisy_frac: float = 0.85,
+                 victim_rps: float = 5.0, noisy_rps: float = 50.0,
+                 storm_start_s: float = 1.0, prompt_len: int = 16,
+                 max_new: int = 8) -> Iterator[TraceRequest]:
+    """Noisy-neighbor flood (ISSUE 19): a `victim` tenant's steady
+    trickle overlaid with a `noisy` tenant's over-quota flood starting
+    at `storm_start_s`. With per-tenant admission, the flood sheds as
+    `tenant_quota` against the noisy tenant alone and the victim's tail
+    latency stays flat; without it, the victim starves behind the
+    flood's queue."""
+    rng = random.Random(f"tenant_storm:{seed}")
+    n_noisy = int(n * noisy_frac)
+    arrivals: list[tuple[float, str]] = []
+    t = 0.0
+    for _ in range(n - n_noisy):
+        t += rng.expovariate(victim_rps)
+        arrivals.append((t, "victim"))
+    t = storm_start_s
+    for _ in range(n_noisy):
+        t += rng.expovariate(noisy_rps)
+        arrivals.append((t, "noisy"))
+    arrivals.sort(key=lambda p: p[0])
+    for i, (at, tenant) in enumerate(arrivals):
+        yield TraceRequest(
+            i=i, at=at,
+            prompt_len=prompt_len, max_new=max_new,
+            seed=i, prompt_seed=rng.randrange(1 << 31),
+            tenant=tenant,
+        )
+
+
 def bench_mix(seed: int, *, n: int = 96) -> Iterator[TraceRequest]:
     """The serving_bench request mix as a trace (ISSUE 16 satellite):
     a modest pool of 12 distinct prompt lengths — enough variety that
@@ -328,6 +367,7 @@ GENERATORS = {
     "flood": flood,
     "shared_prefix": shared_prefix,
     "disconnect_storm": disconnect_storm,
+    "tenant_storm": tenant_storm,
     "bench_mix": bench_mix,
     "single_shape": single_shape,
 }

@@ -132,14 +132,22 @@ class TwinConfig:
     # PLACEMENT effects of the cache, not its pool residency.
     prefix_cache: bool = False
     prefix_affinity: bool = True
+    # ISSUE 19: per-tenant admission — tenant name → cap on FLEET-wide
+    # outstanding rows (queued + in flight). An over-cap arrival sheds
+    # `tenant_quota` before routing, exactly like the real servers'
+    # TenantAdmission (whose caps are per replica — a twin modeling an
+    # N-replica rig should multiply accordingly). None/missing = uncapped.
+    tenants: Optional[dict] = None
 
 
 class _Row:
     __slots__ = ("i", "arrive_t", "prompt_len", "max_new", "deadline",
-                 "disconnect_after_ms", "pages", "attempts", "prefix_group")
+                 "disconnect_after_ms", "pages", "attempts", "prefix_group",
+                 "tenant")
 
     def __init__(self, rec: TraceRequest, arrive_t: float, pages: int):
         self.i = rec.i
+        self.tenant = rec.tenant or "default"
         self.arrive_t = arrive_t
         self.prompt_len = rec.prompt_len
         self.max_new = rec.max_new
@@ -229,6 +237,10 @@ class ServingTwin:
         # prefix-directory ledger (ISSUE 17)
         self.prefix_lookups = 0
         self.prefix_hits = 0
+        # tenancy ledger (ISSUE 19): fleet-wide outstanding per tenant
+        # plus the per-tenant outcome breakdown the assertions read
+        self._tenant_out: dict[str, int] = {}
+        self._tenant_stats: dict[str, dict] = {}
         # arrival-ordered value tapes for the history predicates
         # (ISSUE 18): same series names run_real builds off the ledger
         self.tapes = {
@@ -242,9 +254,39 @@ class ServingTwin:
         self._seq += 1
         heapq.heappush(self._events, (t, self._seq, kind, data))
 
+    # ---------------------------------------------------------- tenancy
+    def _tstat(self, tenant: str) -> dict:
+        return self._tenant_stats.setdefault(tenant, {
+            "offered": 0, "ok": 0, "shed": 0, "error": 0,
+            "shed_reasons": {}, "_lat": [],
+        })
+
+    def _tenant_shed(self, tenant: str, reason: str) -> None:
+        st = self._tstat(tenant)
+        st["shed"] += 1
+        st["shed_reasons"][reason] = st["shed_reasons"].get(reason, 0) + 1
+
+    def _tenant_done(self, tenant: str) -> None:
+        if self._tenant_out.get(tenant):
+            self._tenant_out[tenant] -= 1
+
     # ---------------------------------------------------------- routing
     def _admit(self, rec: TraceRequest, now: float) -> None:
         self.offered += 1
+        tenant = rec.tenant or "default"
+        self._tstat(tenant)["offered"] += 1
+        cap = (self.cfg.tenants or {}).get(tenant)
+        if cap is not None and self._tenant_out.get(tenant, 0) >= cap:
+            # over-cap arrival: shed against THIS tenant before routing,
+            # the real stack's TenantAdmission.admit
+            self.counts["shed"] += 1
+            self.shed_reasons["tenant_quota"] = (
+                self.shed_reasons.get("tenant_quota", 0) + 1
+            )
+            self._tenant_shed(tenant, "tenant_quota")
+            self.tapes["ok"].add(0.0)
+            self.resolved += 1
+            return
         pages = 0
         if self.cfg.kv_pool_pages:
             pages = -(-(rec.prompt_len + rec.max_new) // self.cfg.kv_page_tokens)
@@ -282,10 +324,12 @@ class ServingTwin:
                 continue
             rep.pages_used += pages
             rep.queue.append(row)
+            self._tenant_out[tenant] = self._tenant_out.get(tenant, 0) + 1
             self._maybe_start(i, now)
             return
         self.counts["shed"] += 1
         self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        self._tenant_shed(tenant, reason)
         self.tapes["ok"].add(0.0)
         self.resolved += 1
 
@@ -311,6 +355,8 @@ class ServingTwin:
             self._maybe_start(i, now)
             return
         self.counts["error"] += 1
+        self._tstat(row.tenant)["error"] += 1
+        self._tenant_done(row.tenant)
         self.resolved += 1
 
     # ---------------------------------------------------------- service
@@ -326,6 +372,8 @@ class ServingTwin:
                 rep.queue.popleft()
                 rep.pages_used -= head.pages
                 self.counts["deadline_504"] += 1
+                self._tenant_shed(head.tenant, "deadline")
+                self._tenant_done(head.tenant)
                 self.resolved += 1
                 continue
             break
@@ -376,9 +424,15 @@ class ServingTwin:
                 end = first_token_t + row.disconnect_after_ms / 1e3
                 self.counts["disconnected"] += 1
                 self._observe(min(end, now) - row.arrive_t, ttft_ms)
+                self._tstat(row.tenant)["ok"] += 1
             else:
                 self.counts["ok"] += 1
                 self._observe(now - row.arrive_t, ttft_ms)
+                st = self._tstat(row.tenant)
+                st["ok"] += 1
+                if len(st["_lat"]) < _RESERVOIR:
+                    st["_lat"].append((now - row.arrive_t) * 1e3)
+            self._tenant_done(row.tenant)
             self.resolved += 1
         self._maybe_start(i, now)
 
@@ -470,5 +524,25 @@ class ServingTwin:
                     if self.prefix_lookups else None
                 ),
             },
+            "by_tenant": self._by_tenant(),
             "sim_duration_s": round(self.clock.time(), 3),
         }
+
+    def _by_tenant(self) -> dict:
+        """The same per-tenant breakdown ReplayReport.summary builds —
+        empty unless the trace actually named tenants."""
+        if not (set(self._tenant_stats) - {"default"}):
+            return {}
+        out = {}
+        for t, st in sorted(self._tenant_stats.items()):
+            lat = sorted(st["_lat"])
+            out[t] = {
+                "offered": st["offered"], "ok": st["ok"],
+                "shed": st["shed"], "error": st["error"],
+                "shed_reasons": dict(st["shed_reasons"]),
+                "latency_ms": {
+                    "p50": quantile(lat, 0.5),
+                    "p99": quantile(lat, 0.99),
+                },
+            }
+        return out

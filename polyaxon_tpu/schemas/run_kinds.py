@@ -124,6 +124,37 @@ class V1TrainSpec(BaseSchema):
         return self
 
 
+class V1TenantSpec(BaseSchema):
+    """One serving tenant's admission contract (ISSUE 19) — V1QuotaSpec
+    semantics at the request level: caps on outstanding requests and
+    outstanding token budget, a weighted fair share, and optionally the
+    named LoRA adapter the tenant's rows decode with."""
+
+    name: str
+    max_outstanding: Optional[int | str] = None
+    max_tokens: Optional[int | str] = None
+    weight: float | str = 1.0
+    adapter: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not self.name.strip():
+            raise ValueError("tenant name must be non-empty")
+        for field in ("max_outstanding", "max_tokens"):
+            v = getattr(self, field)
+            if isinstance(v, int) and v < 0:
+                raise ValueError(
+                    f"tenant {self.name!r}: {to_camel(field)} must be "
+                    f">= 0, got {v}"
+                )
+        if isinstance(self.weight, (int, float)) and self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0, "
+                f"got {self.weight}"
+            )
+        return self
+
+
 class V1ServingSpec(BaseSchema):
     """Serving fast-path knobs (serving/batching.py) a run can pin in its
     spec, so `polyaxon serve --uid <run>` comes up with the shape the model
@@ -204,6 +235,16 @@ class V1ServingSpec(BaseSchema):
     spill_ram_bytes: Optional[int | str] = None
     spill_dir: Optional[str] = None
     spill_dir_bytes: Optional[int | str] = None
+    # multi-tenant serving (ISSUE 19): `adapters` names the LoRA adapters
+    # this server multiplexes (name → .npz path or "seed:<int>"; requires
+    # a loraRank-trained checkpoint), `tenants` their admission contracts
+    # (per-tenant caps + weighted fair share), and `adapterSlots` caps the
+    # device-resident adapters beyond the checkpoint's own slot 0 (0 =
+    # one slot per adapter; lower values evict idle adapters LRU through
+    # the spill tiers and restore on request).
+    adapters: Optional[dict[str, str]] = None
+    tenants: Optional[list[V1TenantSpec]] = None
+    adapter_slots: int | str = 0
 
     _MESH_AXES_ALLOWED = ("batch", "model", "data", "fsdp")
 
@@ -336,6 +377,31 @@ class V1ServingSpec(BaseSchema):
                 raise ValueError(
                     f"{name} must be a non-empty list of positive ints"
                 )
+        if self.adapters is not None:
+            for name, src in self.adapters.items():
+                if not str(name).strip() or not str(src).strip():
+                    raise ValueError(
+                        "adapters entries must map a non-empty name to a "
+                        f"non-empty source, got {name!r}: {src!r}"
+                    )
+        if self.tenants:
+            seen: set[str] = set()
+            known = set(self.adapters or {})
+            for t in self.tenants:
+                if t.name in seen:
+                    raise ValueError(f"duplicate tenant name {t.name!r}")
+                seen.add(t.name)
+                if t.adapter and t.adapter not in known:
+                    raise ValueError(
+                        f"tenant {t.name!r} binds adapter {t.adapter!r} "
+                        f"which is not in adapters "
+                        f"({sorted(known) or 'none declared'})"
+                    )
+        if isinstance(self.adapter_slots, int) and self.adapter_slots < 0:
+            raise ValueError(
+                f"adapterSlots must be >= 0 (0 = one slot per adapter), "
+                f"got {self.adapter_slots}"
+            )
         return self
 
     def to_config(self):
@@ -344,6 +410,7 @@ class V1ServingSpec(BaseSchema):
             normalize_draft_model,
             normalize_mesh_axes,
         )
+        from ..serving.tenancy import normalize_adapters, normalize_tenants
 
         return ServingConfig(
             max_batch=int(self.max_batch),
@@ -398,6 +465,28 @@ class V1ServingSpec(BaseSchema):
                 if self.mesh_axes is not None
                 else None
             ),
+            adapters=normalize_adapters(self.adapters or {}),
+            tenants=normalize_tenants(
+                [
+                    {
+                        "name": t.name,
+                        "max_outstanding": (
+                            int(t.max_outstanding)
+                            if t.max_outstanding is not None
+                            else None
+                        ),
+                        "max_tokens": (
+                            int(t.max_tokens)
+                            if t.max_tokens is not None
+                            else None
+                        ),
+                        "weight": float(t.weight),
+                        "adapter": t.adapter or "",
+                    }
+                    for t in (self.tenants or [])
+                ]
+            ),
+            adapter_slots=int(self.adapter_slots),
         )
 
     def chips_needed(self) -> Optional[int]:

@@ -242,6 +242,20 @@ class ServingConfig:
     spill_ram_bytes: Optional[int] = None
     spill_dir: Optional[str] = None
     spill_dir_bytes: Optional[int] = None
+    # multi-tenant serving (ISSUE 19): named LoRA adapters hot-swapped
+    # into the stacked slot params (serving/adapters.py) and per-tenant
+    # admission contracts (serving/tenancy.py).
+    # adapters — sorted (name, source) pairs; source is an .npz path or
+    #   "seed:<int>". Requires lora_rank > 0 on the served model.
+    # tenants — sorted TenantSpec pair-tuples (tenancy.normalize_tenants);
+    #   each may bind an adapter and carry outstanding/token caps + a
+    #   fair-share weight.
+    # adapter_slots — device-resident adapter slots BEYOND slot 0 (the
+    #   checkpoint's own adapter); 0 = auto: one slot per configured
+    #   adapter (no eviction until operators cap it lower).
+    adapters: tuple = ()
+    tenants: tuple = ()
+    adapter_slots: int = 0
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -342,6 +356,13 @@ class PendingRequest:
     # flips this when the socket breaks; the coalescer/scheduler notice
     # at their next sweep and release the row's resources promptly
     cancelled: bool = False
+    # multi-tenant serving (ISSUE 19): the tenant this row bills against
+    # and the adapter slot its decode gathers (0 = the base adapter).
+    # Runtime per-row state, deliberately NOT part of GroupKey: one
+    # coalesced group mixes tenants.
+    tenant: str = "default"
+    adapter: str = ""  # adapter name, for registry release on finish
+    adapter_slot: int = 0
 
     def cancel(self) -> None:
         """Mark the row as abandoned by its client. Safe from any thread;
@@ -495,6 +516,7 @@ class DecodeCoalescer:
         max_queue: int = 64,
         breaker: Optional[CircuitBreaker] = None,
         observer: Optional[Callable[..., None]] = None,
+        tenancy=None,  # serving.tenancy.TenantAdmission (ISSUE 19)
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -506,6 +528,7 @@ class DecodeCoalescer:
         self.max_queue = int(max_queue)
         self._breaker = breaker
         self._observer = observer
+        self.tenancy = tenancy
         self._queue: queue.Queue = queue.Queue()
         self._pending: deque[PendingRequest] = deque()
         self._inflight: Optional[list[PendingRequest]] = None
@@ -568,26 +591,65 @@ class DecodeCoalescer:
             )
         if req.expired():
             self._shed(
-                "deadline", "request deadline already expired at admission"
+                "deadline", "request deadline already expired at admission",
+                tenant=req.tenant,
             )
         if self._breaker is not None and not self._breaker.allow():
             self._shed(
                 "breaker_open",
                 "circuit breaker open: decode is failing, try again later",
                 retry_after_s=max(1.0, self._breaker.cooldown_s),
+                tenant=req.tenant,
             )
-        if self.depth >= self.max_queue:
-            self._shed(
-                "queue_full",
-                f"decode queue full ({self.max_queue} requests in flight)",
-            )
+        # per-tenant admission (ISSUE 19): charge the row's token budget
+        # against its tenant BEFORE the global queue check, so a tenant's
+        # flood sheds as `tenant_quota` on THAT tenant while everyone
+        # else's requests never see a fuller queue
+        release = None
+        if self.tenancy is not None:
+            try:
+                release = self.tenancy.admit(
+                    req.tenant, req.prompt_len + req.max_new
+                )
+            except ShedError as e:
+                with self._count_lock:
+                    self.shed_total += 1
+                self._observe("shed", reason=e.reason, tenant=req.tenant)
+                raise
+            prev = req.on_finish
+
+            def _finish_release(r, _prev=prev, _rel=release):
+                try:
+                    if _prev is not None:
+                        _prev(r)
+                finally:
+                    _rel()  # idempotent: exactly-once per admitted row
+
+            req.on_finish = _finish_release
+        try:
+            if self.depth >= self.max_queue:
+                self._shed(
+                    "queue_full",
+                    f"decode queue full ({self.max_queue} requests in flight)",
+                    tenant=req.tenant,
+                )
+        except BaseException:
+            if release is not None:
+                release()  # never charge a tenant for a row we refused
+            raise
         self._admit()
         self._queue.put(req)
 
-    def _shed(self, reason: str, message: str, retry_after_s: float = 1.0):
+    def _shed(
+        self,
+        reason: str,
+        message: str,
+        retry_after_s: float = 1.0,
+        tenant: Optional[str] = None,
+    ):
         with self._count_lock:
             self.shed_total += 1
-        self._observe("shed", reason=reason)
+        self._observe("shed", reason=reason, tenant=tenant)
         raise ShedError(message, reason=reason, retry_after_s=retry_after_s)
 
     # ------------------------------------------------------------ lifecycle
@@ -730,7 +792,21 @@ class DecodeCoalescer:
             if not self._pending:
                 alive = self._drain_into_pending(timeout=0.1)
                 continue
-            head = self._pending[0]
+            # weighted fair head pick (ISSUE 19): among tenants with
+            # pending work, serve the one with the smallest outstanding
+            # tokens ÷ weight (FIFO inside a tenant via the enqueue-time
+            # tiebreak). Without tenancy this is exactly the old
+            # oldest-first rule. The group still mixes tenants: head only
+            # chooses WHICH key flushes next.
+            if self.tenancy is not None and len(self._pending) > 1:
+                head = min(
+                    self._pending,
+                    key=lambda r: (
+                        self.tenancy.share(r.tenant), r.enqueued_at
+                    ),
+                )
+            else:
+                head = self._pending[0]
             batch = [r for r in self._pending if r.key == head.key][
                 : self.max_batch
             ]

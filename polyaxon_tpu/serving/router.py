@@ -607,6 +607,7 @@ class Router:
         rid: str,
         *,
         query: str = "",
+        tenant: str = "",
         trace: Optional[RequestTrace] = None,
     ) -> tuple[int, bytes, dict]:
         """Non-streaming forward: returns (status, payload bytes,
@@ -636,7 +637,9 @@ class Router:
             if i > 0:
                 self._m_retries.inc()
             t_att = _now()
-            status, payload, headers = self._forward_once(s, body, rid, query)
+            status, payload, headers = self._forward_once(
+                s, body, rid, query, tenant
+            )
             retryable = self._retryable(status, payload)
             if trace is not None:
                 trace.add(
@@ -669,16 +672,23 @@ class Router:
         return False
 
     def _forward_once(
-        self, s: ReplicaState, body: bytes, rid: str, query: str
+        self, s: ReplicaState, body: bytes, rid: str, query: str,
+        tenant: str = "",
     ) -> tuple[int, bytes, dict]:
         url = s.url + "/generate" + (f"?{query}" if query else "")
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": rid,
+        }
+        # tenancy (ISSUE 19): the client's X-Tenant rides every upstream
+        # hop — body bytes stay verbatim, the replica folds the header
+        # into admission exactly as on a direct request
+        if tenant:
+            headers["X-Tenant"] = tenant
         req = urlrequest.Request(
             url,
             data=body,
-            headers={
-                "Content-Type": "application/json",
-                "X-Request-Id": rid,
-            },
+            headers=headers,
             method="POST",
         )
         with self._rlock:
@@ -711,6 +721,7 @@ class Router:
         rid: str,
         *,
         query: str = "",
+        tenant: str = "",
         trace: Optional[RequestTrace] = None,
     ):
         """Generator of raw SSE frame bytes, with mid-stream failover.
@@ -760,7 +771,9 @@ class Router:
                     )
             t_att = _now()
             try:
-                gen = self._stream_once(s, body, rid, query, sent, done_rows)
+                gen = self._stream_once(
+                    s, body, rid, query, sent, done_rows, tenant
+                )
                 for frame in gen:
                     started = True
                     yield frame
@@ -812,17 +825,21 @@ class Router:
         query: str,
         sent: dict[int, int],
         done_rows: set[int],
+        tenant: str = "",
     ):
         q = query or "stream=1"
         if "stream=1" not in q.split("&"):
             q += "&stream=1"
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": rid,
+        }
+        if tenant:
+            headers["X-Tenant"] = tenant
         req = urlrequest.Request(
             s.url + "/generate?" + q,
             data=body,
-            headers={
-                "Content-Type": "application/json",
-                "X-Request-Id": rid,
-            },
+            headers=headers,
             method="POST",
         )
         with self._rlock:
@@ -1191,6 +1208,7 @@ class Router:
                     (self.headers.get("X-Request-Id") or "").strip()[:128]
                     or new_trace_id()
                 )
+                tenant = (self.headers.get("X-Tenant") or "").strip()[:128]
                 router._m_requests.inc()
                 t0 = _now()
                 tr = (
@@ -1210,11 +1228,13 @@ class Router:
                             bytes=len(body),
                         )
                     if "stream=1" in query.split("&"):
-                        status = self._relay_stream(body, rid, query, tr)
+                        status = self._relay_stream(
+                            body, rid, query, tr, tenant
+                        )
                         status_out = _trace_status(status)
                     else:
                         status, payload, headers = router.forward(
-                            body, rid, query=query, trace=tr
+                            body, rid, query=query, tenant=tenant, trace=tr
                         )
                         status_out = _trace_status(status)
                         fwd = {
@@ -1246,8 +1266,10 @@ class Router:
                     router._m_latency.observe(_now() - t0, exemplar=rid)
                     router.finish_trace(tr, status_out, err_out)
 
-            def _relay_stream(self, body, rid, query, tr=None):
-                gen = router.forward_stream(body, rid, query=query, trace=tr)
+            def _relay_stream(self, body, rid, query, tr=None, tenant=""):
+                gen = router.forward_stream(
+                    body, rid, query=query, tenant=tenant, trace=tr
+                )
                 try:
                     first = next(gen)  # admission errors raise here
                 except _StreamError as e:

@@ -236,6 +236,55 @@ class ModelServer:
             module, params, self._quant_bytes_saved = quantize_module(
                 module, params
             )
+        # multi-tenant adapter multiplexing (ISSUE 19): stack the restored
+        # checkpoint's LoRA params to [slots, ...] — slot 0 keeps the
+        # checkpoint's own adapter, slots 1..N start zero for the registry
+        # to hot-swap. Runs AFTER quantize (int8 base + fp adapters
+        # compose) and BEFORE the mesh device_put (the slot axis must land
+        # replicated: the per-row gather must not become a collective).
+        self._tenancy = None
+        self._adapter_registry = None
+        self._adapter_spill = None
+        self._adapter_sources = dict(self.config.adapters or ())
+        self._adapter_slots_active = False
+        self._adapter_n_hot = 0
+        sharding_rules = tuple(sharding_rules or ())
+        if self._adapter_sources or self.config.adapter_slots:
+            if getattr(module.cfg, "lora_rank", 0) <= 0:
+                raise ValueError(
+                    "serving adapters require a LoRA model (lora_rank > 0): "
+                    "this checkpoint has no adapter params to multiplex"
+                )
+            n_hot = int(self.config.adapter_slots) or len(self._adapter_sources)
+            if n_hot < 1:
+                raise ValueError(
+                    "adapter_slots must be >= 1 when adapters are configured"
+                )
+            from .adapters import stack_adapter_params
+
+            module, params = stack_adapter_params(
+                module, params, slots=n_hot + 1
+            )
+            self._adapter_slots_active = True
+            self._adapter_n_hot = n_hot
+            # mirror build_transformer's rule rewrite: prepend the slot
+            # axis (replicated) to every lora_* sharding rule, since
+            # _spec_for applies axes positionally from dim 0
+            sharding_rules = tuple(
+                (pat, (None, *axes)) if "lora_" in pat else (pat, axes)
+                for pat, axes in sharding_rules
+            )
+        if self.config.tenants or self._adapter_sources:
+            from .tenancy import TenantAdmission, TenantSpec
+
+            self._tenancy = TenantAdmission(self.config.tenants)
+            for pairs in self.config.tenants or ():
+                spec = TenantSpec.from_pairs(pairs)
+                if spec.adapter and spec.adapter not in self._adapter_sources:
+                    raise ValueError(
+                        f"tenant {spec.name!r} binds adapter "
+                        f"{spec.adapter!r}, which is not configured"
+                    )
         # tensor-parallel decode (ISSUE 10): a named 2-D `batch`×`model`
         # mesh. from_run passes the mesh it restored onto (params already
         # land sharded); direct construction builds one from
@@ -499,6 +548,25 @@ class ModelServer:
             help="Streamed /generate requests whose client vanished "
             "mid-stream (broken pipe); rows cancelled, pages released",
         )
+        # multi-tenant observability (ISSUE 19): adapter-swap cost +
+        # per-tenant queue-wait, registered from startup so the
+        # regressionRules (tenant-queue-wait-trend, adapter-thrash-surge)
+        # always have their series
+        self._m_tenant_queue_wait = self.telemetry.histogram(
+            "serving.tenant_queue_wait_seconds",
+            help="Submit-to-dispatch wait for rows of NAMED tenants, "
+            "seconds (the tenant-fairness signal; per-tenant splits in "
+            "serving.queue_wait_by_tenant.*)",
+        )
+        self._m_adapter_load = self.telemetry.histogram(
+            "serving.adapter_load_ms",
+            buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000),
+            help="Wall time to materialize an adapter into its slot on "
+            "acquire (cold load or spill restore), milliseconds",
+        )
+        if self._tenancy is not None:
+            for _t in self._tenancy.known():
+                self._tenant_series(_t)
         self.traces = TraceRing(capacity=int(self.config.trace_ring))
         import itertools
 
@@ -524,13 +592,36 @@ class ModelServer:
                 profile_s=slo_profile_s,
             )
         if slos:
+            objectives = build_objectives(
+                slos,
+                bad=[self._m_http_err],
+                total=[self._m_http],
+                histogram=self._m_latency,
+            )
+            # per-tenant SLOs (ISSUE 19): every latency objective is also
+            # tracked per tenant against that tenant's own latency
+            # histogram, named "<slo>@<tenant>" — a noisy neighbor burning
+            # only its own budget shows up as ITS breach, not the fleet's
+            if self._tenancy is not None:
+                lat_specs = [
+                    s
+                    for s in slos
+                    if s.get("kind", "availability") == "latency"
+                ]
+                for t in self._tenancy.known():
+                    if not lat_specs:
+                        break
+                    objectives += build_objectives(
+                        [
+                            {**s, "name": f"{s.get('name', 'slo')}@{t}"}
+                            for s in lat_specs
+                        ],
+                        bad=[self._m_http_err],
+                        total=[self._m_http],
+                        histogram=self._tenant_series(t)[1],
+                    )
             self.slo_engine = SLOEngine(
-                build_objectives(
-                    slos,
-                    bad=[self._m_http_err],
-                    total=[self._m_http],
-                    histogram=self._m_latency,
-                ),
+                objectives,
                 self.telemetry,
                 on_breach=(
                     self.flight_recorder.dump
@@ -591,6 +682,36 @@ class ModelServer:
         self._compiled: collections.OrderedDict = collections.OrderedDict()
         self._compiled_max = 32
         self._lock = threading.Lock()
+        # adapter registry (ISSUE 19): named LoRA adapters managed like KV
+        # pages — refcounted residency in the stacked slots, LRU evict of
+        # idle adapters through a dedicated SpillManager RAM tier (+ disk
+        # when spill_dir is configured), restore-on-request. The registry
+        # lock serializes the (not thread-safe) SpillManager; slot
+        # reads/writes take self._lock inside it (consistent order, and
+        # finish()-driven release never runs under self._lock).
+        if self._adapter_slots_active:
+            from .adapters import AdapterRegistry, adapter_template
+            from .spill import SpillManager
+
+            self._adapter_template = adapter_template(params)
+            self._adapter_spill = SpillManager(
+                ram_bytes=256 << 20,
+                dir_path=(
+                    str(self.config.spill_dir).rstrip("/") + "/adapters"
+                    if self.config.spill_dir
+                    else None
+                ),
+                dir_bytes=self.config.spill_dir_bytes,
+            )
+            self._adapter_registry = AdapterRegistry(
+                slots=self._adapter_n_hot,
+                sources=self._adapter_sources,
+                template=self._adapter_template,
+                read_slot=self._adapter_read_slot,
+                write_slot=self._adapter_write_slot,
+                spill=self._adapter_spill,
+                telemetry=self.telemetry,
+            )
         self._coalescer: Optional[DecodeCoalescer] = None
         if self.config.batching:
             self._coalescer = self._make_coalescer()
@@ -638,6 +759,7 @@ class ModelServer:
                 max_queue=self.config.max_queue,
                 breaker=breaker,
                 observer=self._observe,
+                tenancy=self._tenancy,
             )
         return DecodeCoalescer(
             self._dispatch_group,
@@ -646,6 +768,7 @@ class ModelServer:
             max_queue=self.config.max_queue,
             breaker=breaker,
             observer=self._observe,
+            tenancy=self._tenancy,
         )
 
     def _observe(self, event: str, **ctx) -> None:
@@ -658,6 +781,16 @@ class ModelServer:
                 f"serving.shed.{reason}",
                 help=f"Requests shed at admission: {reason}",
             ).inc()
+            # per-tenant shed attribution (ISSUE 19): only for tenants the
+            # operator configured — unknown names 400 before admission, so
+            # clients can't mint unbounded metric series
+            tenant = ctx.get("tenant")
+            if (
+                tenant
+                and self._tenancy is not None
+                and tenant in self._tenancy.known()
+            ):
+                self._tenant_series(tenant)[0].inc()
             if reason == "deadline":
                 self._m_deadline.inc()
         elif event == "deadline_dropped":
@@ -700,6 +833,122 @@ class ModelServer:
             self._m_spill_quarantined.inc(int(ctx.get("n", 1)))
         elif event == "shed":
             self._observe("shed", **ctx)
+
+    # ------------------------------------------------------------ tenancy
+    def _tenant_series(self, tenant: str):
+        """Get-or-create the per-tenant series triple: (shed counter,
+        request-latency histogram, queue-wait histogram). Only called for
+        operator-configured tenant names — cardinality is bounded by the
+        run spec, never by clients."""
+        reg = self.telemetry
+        return (
+            reg.counter(
+                f"serving.shed_by_tenant.{tenant}",
+                help=f"Requests shed at admission for tenant {tenant!r}",
+            ),
+            reg.histogram(
+                f"serving.request_seconds_by_tenant.{tenant}",
+                help=f"End-to-end latency for tenant {tenant!r}, seconds",
+            ),
+            reg.histogram(
+                f"serving.queue_wait_by_tenant.{tenant}",
+                help=f"Submit-to-dispatch wait for tenant {tenant!r}, "
+                "seconds",
+            ),
+        )
+
+    def _observe_queue_wait(self, r, wait: float) -> None:
+        """One row's submit→dispatch wait, fanned to the global histogram
+        plus — for named tenants — the fairness signal and the tenant's
+        own split."""
+        self._m_queue_wait.observe(wait)
+        tenant = getattr(r, "tenant", "") or ""
+        if self._tenancy is None or not tenant:
+            return
+        if tenant not in self._tenancy.known():
+            return
+        self._tenant_series(tenant)[2].observe(wait)
+        from .tenancy import DEFAULT_TENANT
+
+        if tenant != DEFAULT_TENANT:
+            # the aggregate fairness-trend signal tracks NAMED tenants
+            # only — default traffic has no contract to regress against
+            self._m_tenant_queue_wait.observe(wait)
+
+    def _observe_tenant_latency(self, tenant: str, dur: float) -> None:
+        if self._tenancy is None or not tenant:
+            return
+        if tenant not in self._tenancy.known():
+            return
+        self._tenant_series(tenant)[1].observe(dur)
+
+    def _observe_body_latency(self, body, dur: float) -> None:
+        """End-to-end latency split by the request body's tenant — feeds
+        the per-tenant latency histograms the per-tenant SLO objectives
+        burn against."""
+        if self._tenancy is None:
+            return
+        try:
+            name = self._tenancy.resolve(
+                str((body or {}).get("tenant") or "")
+            ).name
+        except Exception:  # noqa: BLE001 — unknown tenants 400 elsewhere
+            return
+        self._observe_tenant_latency(name, dur)
+
+    def _adapter_read_slot(self, slot: int) -> list:
+        """Host copies of every LoRA leaf's [slot] slice, in the
+        registry's sorted-template-path order — the spill payload for a
+        demoted adapter."""
+        import numpy as np
+
+        wanted = set(self._adapter_template)
+        found: dict = {}
+
+        def walk(node, prefix):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    walk(v, f"{prefix}/{k}" if prefix else k)
+            elif prefix in wanted:
+                found[prefix] = np.asarray(node[..., slot, :, :])
+
+        with self._lock:
+            walk(self.params, "")
+        return [found[p] for p in sorted(self._adapter_template)]
+
+    def _adapter_write_slot(self, slot: int, adapter: dict) -> None:
+        """Install one adapter (slash-joined path → array) into stacked
+        slot `slot` via functional .at[].set — under self._lock because
+        dispatches snapshot self.params under that same lock before
+        launching their compiled programs."""
+        import jax.numpy as jnp
+
+        def walk(node, prefix):
+            if isinstance(node, dict):
+                return {
+                    k: walk(v, f"{prefix}/{k}" if prefix else k)
+                    for k, v in node.items()
+                }
+            if prefix in adapter:
+                arr = jnp.asarray(adapter[prefix], node.dtype)
+                return node.at[..., slot, :, :].set(arr)
+            return node
+
+        with self._lock:
+            self.params = walk(self.params, "")
+
+    def _adapter_ix(self, batch, bb: int):
+        """[bb] int32 adapter-slot gather indices for one dispatch, or
+        None when this server has no stacked slots. Pad rows ride slot 0
+        (the checkpoint's own adapter) — inert and always resident."""
+        if not self._adapter_slots_active:
+            return None
+        import numpy as np
+
+        ix = np.zeros((bb,), np.int32)
+        for i, r in enumerate(batch):
+            ix[i] = int(getattr(r, "adapter_slot", 0))
+        return ix
 
     # ------------------------------------------------------------ tracing
     def _new_trace(self, rid: str, **attrs) -> Optional[RequestTrace]:
@@ -851,10 +1100,27 @@ class ModelServer:
 
         key = (
             "bucket", batch, prompt_bucket, new_bucket, temperature, top_k,
-            eos_id,
+            eos_id, self._adapter_slots_active,
         )
 
         def build():
+            if self._adapter_slots_active:
+                return jax.jit(
+                    lambda params, prompt, lengths, seeds, adapter_ix: (
+                        generate(
+                            self.module,
+                            params,
+                            prompt,
+                            max_new_tokens=new_bucket,
+                            temperature=temperature,
+                            top_k=top_k,
+                            eos_id=eos_id,
+                            seed=seeds,
+                            prompt_lengths=lengths,
+                            adapter_ix=adapter_ix,
+                        )
+                    )
+                )
             return jax.jit(
                 lambda params, prompt, lengths, seeds: generate(
                     self.module,
@@ -1073,7 +1339,31 @@ class ModelServer:
                     f"deadlineMs must be > 0, got {deadline_ms}"
                 )
             deadline = time.monotonic() + deadline_ms / 1e3
+        # tenant resolution (ISSUE 19): the body's `tenant` field (the
+        # router copies the X-Tenant header into it). Unknown names are a
+        # client error, not a shed — quota isolation is meaningless if
+        # anyone can mint a fresh tenant.
+        raw_tenant = str(body.get("tenant") or "").strip()
+        tenant, adapter = "default", ""
+        if self._tenancy is not None:
+            try:
+                tspec = self._tenancy.resolve(raw_tenant)
+            except KeyError:
+                raise ServingError(f"unknown tenant {raw_tenant!r}")
+            tenant, adapter = tspec.name, tspec.adapter
+        elif raw_tenant and raw_tenant != "default":
+            raise ServingError(
+                f"unknown tenant {raw_tenant!r}: this server has no "
+                "tenants configured"
+            )
+        if adapter and (num_beams > 1 or not self.config.batching):
+            raise ServingError(
+                "adapter-bound tenants require the coalesced decode path "
+                "(no beam search, batching enabled)"
+            )
         return {
+            "tenant": tenant,
+            "adapter": adapter,
             "deadline": deadline,
             "deadline_ms": deadline_ms,
             "arr": arr,
@@ -1112,47 +1402,72 @@ class ModelServer:
             draft_tokens=eff_k,
             quantize=bool(self.config.quantize),
         )
+        adapter = req.get("adapter") or ""
+        tenant = req.get("tenant") or "default"
         out = []
         try:
             for i, row in enumerate(req["arr"]):
-                plan = None
-                if self._kv is not None:
-                    # paged admission: prefix lookup + suffix bucketing +
-                    # page reservation (may shed with reason "kv_pages")
-                    plan = self._kv.plan_row(
-                        row.tolist(),
-                        req["max_new"],
-                        self._prompt_ladder,
-                        self._new_ladder,
-                        int(cfg.seq_len),
-                        trace=req.get("trace"),
-                    )
-                    pb, nb = plan.suffix_bucket, plan.new_bucket
-                    key = GroupKey(
-                        prompt_bucket=pb,
-                        new_bucket=nb,
-                        temperature=req["temperature"],
-                        top_k=req["top_k"],
-                        eos_id=req["eos_id"],
-                        prefix_len=plan.prefix_len,
-                        **mode,
-                    )
-                else:
-                    pb, nb = choose_buckets(
-                        len(row),
-                        req["max_new"],
-                        self._prompt_ladder,
-                        self._new_ladder,
-                        int(cfg.seq_len),
-                    )
-                    key = GroupKey(
-                        prompt_bucket=pb,
-                        new_bucket=nb,
-                        temperature=req["temperature"],
-                        top_k=req["top_k"],
-                        eos_id=req["eos_id"],
-                        **mode,
-                    )
+                # adapter residency first (ISSUE 19): pin the tenant's
+                # adapter slot for this row — may cold-load or restore
+                # from spill (timed into the load histogram), may shed
+                # with reason "adapter_capacity" when every slot is
+                # pinned by in-flight rows
+                slot, acquired = 0, False
+                if adapter:
+                    t0a = _now()
+                    try:
+                        slot, loaded = self._adapter_registry.acquire(
+                            adapter
+                        )
+                    except KeyError:
+                        raise ServingError(f"unknown adapter {adapter!r}")
+                    acquired = True
+                    if loaded:
+                        self._m_adapter_load.observe((_now() - t0a) * 1e3)
+                try:
+                    plan = None
+                    if self._kv is not None:
+                        # paged admission: prefix lookup + suffix
+                        # bucketing + page reservation (may shed with
+                        # reason "kv_pages")
+                        plan = self._kv.plan_row(
+                            row.tolist(),
+                            req["max_new"],
+                            self._prompt_ladder,
+                            self._new_ladder,
+                            int(cfg.seq_len),
+                            trace=req.get("trace"),
+                        )
+                        pb, nb = plan.suffix_bucket, plan.new_bucket
+                        key = GroupKey(
+                            prompt_bucket=pb,
+                            new_bucket=nb,
+                            temperature=req["temperature"],
+                            top_k=req["top_k"],
+                            eos_id=req["eos_id"],
+                            prefix_len=plan.prefix_len,
+                            **mode,
+                        )
+                    else:
+                        pb, nb = choose_buckets(
+                            len(row),
+                            req["max_new"],
+                            self._prompt_ladder,
+                            self._new_ladder,
+                            int(cfg.seq_len),
+                        )
+                        key = GroupKey(
+                            prompt_bucket=pb,
+                            new_bucket=nb,
+                            temperature=req["temperature"],
+                            top_k=req["top_k"],
+                            eos_id=req["eos_id"],
+                            **mode,
+                        )
+                except BaseException:
+                    if acquired:
+                        self._adapter_registry.release(adapter)
+                    raise
                 r = PendingRequest(
                     tokens=row.tolist(),
                     prompt_len=len(row),
@@ -1165,24 +1480,33 @@ class ModelServer:
                     request_id=req.get("rid"),
                     trace=req.get("trace"),
                     row=i,
+                    tenant=tenant,
+                    adapter=adapter,
+                    adapter_slot=slot,
                 )
-                if plan is not None:
+                if plan is not None or adapter:
                     # on ANY terminal path (scatter, shed, deadline, crash,
                     # drain) the row's pages/reservation/prefix refs return
-                    # to the pool — finish() is idempotent, so is release()
-                    r.on_finish = self._release_plan
+                    # to the pool and its adapter slot unpins — finish()
+                    # is idempotent, so is release()
+                    r.on_finish = self._release_row
                 out.append(r)
         except (ShedError, ServingError):
-            # row k failed admission: rows 0..k-1 already hold reservations
+            # row k failed admission: rows 0..k-1 already hold
+            # reservations and adapter pins
             for r in out:
-                if r.kv_plan is not None:
-                    self._kv.release(r.kv_plan)
+                self._release_row(r)
             raise
         return out
 
-    def _release_plan(self, r: PendingRequest) -> None:
+    def _release_row(self, r: PendingRequest) -> None:
         if r.kv_plan is not None and self._kv is not None:
             self._kv.release(r.kv_plan)
+        if r.adapter and self._adapter_registry is not None:
+            self._adapter_registry.release(r.adapter)
+
+    # retained name: tests and older callsites reach for _release_plan
+    _release_plan = _release_row
 
     # ------------------------------------------------------------ compute
     def _execute_group(self, batch: list[PendingRequest]):
@@ -1203,7 +1527,7 @@ class ModelServer:
         inject("serving.decode", rows=n)
         qnow = _time.monotonic()  # same clock as PendingRequest.enqueued_at
         for r in batch:
-            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+            self._observe_queue_wait(r, max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
         gid, td = self._trace_group(batch)
@@ -1216,18 +1540,20 @@ class ModelServer:
             arr[i, P - r.prompt_len:] = r.tokens
             lengths[i] = r.prompt_len
             seeds[i] = r.seed
+        ix = self._adapter_ix(batch, bb)
         with self._lock:
             fn = self._bucketed_fn(
                 bb, P, N, key.temperature, key.top_k, key.eos_id
             )
-            out = np.asarray(
-                fn(
-                    self.params,
-                    jnp.asarray(arr),
-                    jnp.asarray(lengths),
-                    jnp.asarray(seeds),
-                )
-            )
+            args = [
+                self.params,
+                jnp.asarray(arr),
+                jnp.asarray(lengths),
+                jnp.asarray(seeds),
+            ]
+            if ix is not None:
+                args.append(jnp.asarray(ix))
+            out = np.asarray(fn(*args))
         for i, r in enumerate(batch):
             pad = P - r.prompt_len
             if r.t0 is not None:
@@ -1376,7 +1702,7 @@ class ModelServer:
         inject("serving.decode", rows=n)
         qnow = _time.monotonic()
         for r in batch:
-            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+            self._observe_queue_wait(r, max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
         gid, td = self._trace_group(batch)
@@ -1389,6 +1715,7 @@ class ModelServer:
             arr[i, P - r.prompt_len:] = r.tokens
             lengths[i] = r.prompt_len
             seeds[i] = r.seed
+        ix = self._adapter_ix(batch, bb)
         stats: dict = {}
         with self._lock:
             prefill_fn = self._spec_prefill_fn(
@@ -1419,6 +1746,7 @@ class ModelServer:
                     verify_fn=verify_fn,
                     stats=stats,
                     drafter=drafter,
+                    adapter_ix=None if ix is None else jnp.asarray(ix),
                 )
             )
         self._spec_observe(stats)
@@ -1468,7 +1796,7 @@ class ModelServer:
         inject("serving.decode", rows=n)
         qnow = _time.monotonic()
         for r in batch:
-            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+            self._observe_queue_wait(r, max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
         gid, td = self._trace_group(batch)
@@ -1485,6 +1813,7 @@ class ModelServer:
             arr[i, pb - len(sfx):] = sfx
             pads[i] = pb - len(sfx)
             seeds[i] = r.seed
+        ix = self._adapter_ix(batch, bb)
         kv.ensure_pages(plans[:n], upto_slot=L + pb, traces=traces)
         tables = kv.tables(plans, bb, n_pages)
         with self._lock:
@@ -1494,14 +1823,17 @@ class ModelServer:
             fn = self._paged_prefill_fn(
                 bb, pb, L, n_pages, key.temperature, key.top_k
             )
-            kv.cache, first = fn(
+            pf_args = [
                 self.params,
                 kv.cache,
                 jnp.asarray(arr),
                 jnp.asarray(pads),
                 jnp.asarray(tables),
                 jnp.asarray(seeds),
-            )
+            ]
+            if ix is not None:
+                pf_args.append(jnp.asarray(ix))
+            kv.cache, first = fn(*pf_args)
         first_np = np.asarray(first)
         tnow = _now()
         gen = [[int(first_np[i])] for i in range(n)]
@@ -1595,7 +1927,7 @@ class ModelServer:
                     bb, K, L, n_pages, key.temperature, key.top_k,
                     key.eos_id,
                 )
-                kv.cache, targets, accept = fn(
+                vf_args = [
                     self.params,
                     kv.cache,
                     jnp.asarray(fed),
@@ -1605,7 +1937,10 @@ class ModelServer:
                     jnp.asarray(seeds),
                     jnp.asarray(pos, jnp.int32),
                     jnp.asarray(start_g, jnp.int32),
-                )
+                ]
+                if ix is not None:
+                    vf_args.append(jnp.asarray(ix))
+                kv.cache, targets, accept = fn(*vf_args)
             committed, done, remaining, eos_hit, delta = commit_window(
                 fed, targets, accept, remaining, done, key.eos_id
             )
@@ -1759,7 +2094,7 @@ class ModelServer:
         inject("serving.decode", rows=n)
         qnow = _time.monotonic()
         for r in batch:
-            self._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+            self._observe_queue_wait(r, max(0.0, qnow - r.enqueued_at))
         self._m_occupancy.observe(n)
         self._m_batches.inc()
         gid, td = self._trace_group(batch)
@@ -1778,6 +2113,7 @@ class ModelServer:
             pads[i] = pb - len(sfx)
             seeds[i] = r.seed
         # prefill: writes suffix KV into slots [L, L+pb) of each row's pages
+        ix = self._adapter_ix(batch, bb)
         kv.ensure_pages(plans[:n], upto_slot=L + pb, traces=traces)
         tables = kv.tables(plans, bb, n_pages)
         with self._lock:
@@ -1787,14 +2123,17 @@ class ModelServer:
             fn = self._paged_prefill_fn(
                 bb, pb, L, n_pages, key.temperature, key.top_k
             )
-            kv.cache, first = fn(
+            pf_args = [
                 self.params,
                 kv.cache,
                 jnp.asarray(arr),
                 jnp.asarray(pads),
                 jnp.asarray(tables),
                 jnp.asarray(seeds),
-            )
+            ]
+            if ix is not None:
+                pf_args.append(jnp.asarray(ix))
+            kv.cache, first = fn(*pf_args)
         first_np = np.asarray(first)
         tnow = _now()
         gen = [[int(first_np[i])] for i in range(n)]
@@ -1828,7 +2167,7 @@ class ModelServer:
                     bb, steps, L, n_pages, key.temperature, key.top_k,
                     key.eos_id,
                 )
-                kv.cache, toks, done = fn(
+                ck_args = [
                     self.params,
                     kv.cache,
                     tok,
@@ -1838,7 +2177,10 @@ class ModelServer:
                     jnp.asarray(seeds),
                     jnp.asarray(pos, jnp.int32),
                     jnp.asarray(g, jnp.int32),
-                )
+                ]
+                if ix is not None:
+                    ck_args.append(jnp.asarray(ix))
+                kv.cache, toks, done = fn(*ck_args)
             toks_np = np.asarray(toks)
             for i, r in enumerate(batch):
                 already = len(gen[i])
@@ -2026,7 +2368,9 @@ class ModelServer:
             error = e
             raise
         finally:
-            self._m_latency.observe(_now() - t0, exemplar=rid)
+            dur = _now() - t0
+            self._m_latency.observe(dur, exemplar=rid)
+            self._observe_body_latency(body, dur)
             self._finish_trace(trace, error)
 
     def _handle_request(
@@ -2042,6 +2386,8 @@ class ModelServer:
             )
         req = self._validate(body)
         req["rid"], req["trace"] = rid, trace
+        if trace is not None and req.get("tenant"):
+            trace.attrs["tenant"] = req["tenant"]
         if (
             self._coalescer is None
             or self._coalescer._thread is None
@@ -2071,13 +2417,14 @@ class ModelServer:
                 submitted.append(r)
         except ShedError:
             # multi-row body partially admitted: the unsubmitted rows give
-            # their page reservations back NOW (nobody will finish them);
-            # then wait out the admitted rows (they resolve normally,
-            # results discarded, on_finish releases their pages) and report
-            # the shed — the client retries the whole body
+            # their page reservations and adapter pins back NOW (nobody
+            # will finish them); then wait out the admitted rows (they
+            # resolve normally, results discarded, on_finish releases
+            # their resources) and report the shed — the client retries
+            # the whole body
             for r in rows:
-                if r not in submitted and r.kv_plan is not None:
-                    self._kv.release(r.kv_plan)
+                if r not in submitted:
+                    self._release_row(r)
             for r in submitted:
                 r.done.wait(self.config.request_timeout_s)
             raise
@@ -2126,7 +2473,9 @@ class ModelServer:
             error = e
             raise
         finally:
-            self._m_latency.observe(_now() - t0, exemplar=rid)
+            dur = _now() - t0
+            self._m_latency.observe(dur, exemplar=rid)
+            self._observe_body_latency(body, dur)
             self._finish_trace(trace, error)
 
     def _stream_request(
@@ -2144,6 +2493,8 @@ class ModelServer:
             )
         req = self._validate(body)
         req["rid"], req["trace"] = rid, trace
+        if trace is not None and req.get("tenant"):
+            trace.attrs["tenant"] = req["tenant"]
         if (
             self._kv is None
             or self._coalescer is None
@@ -2187,8 +2538,8 @@ class ModelServer:
                     submitted.append(r)
             except ShedError:
                 for r in rows:
-                    if r not in submitted and r.kv_plan is not None:
-                        self._kv.release(r.kv_plan)
+                    if r not in submitted:
+                        self._release_row(r)
                 for r in submitted:
                     r.done.wait(self.config.request_timeout_s)
                 raise
@@ -2396,7 +2747,15 @@ class ModelServer:
                 "devices": int(self._mesh.devices.size),
                 "axes": {k: int(v) for k, v in self._mesh.shape.items()},
             }
+        tenancy = {"enabled": self._tenancy is not None}
+        if self._tenancy is not None:
+            tenancy["tenants"] = self._tenancy.snapshot()
+        if self._adapter_registry is not None:
+            tenancy["adapters"] = self._adapter_registry.stats()
+            if self._adapter_spill is not None:
+                tenancy["adapter_spill"] = self._adapter_spill.stats()
         return {
+            "tenancy": tenancy,
             "mesh": mesh,
             "kv": kv,
             "chunked": chunked,
@@ -2593,6 +2952,14 @@ class ModelServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
+                    # X-Tenant pass-through (ISSUE 19): the router (and
+                    # any proxy) forwards the tenant as a header; the
+                    # body field wins when both are present
+                    hdr_tenant = (
+                        self.headers.get("X-Tenant") or ""
+                    ).strip()[:128]
+                    if hdr_tenant and isinstance(body, dict):
+                        body.setdefault("tenant", hdr_tenant)
                     if want_stream and server.config.stream:
                         self._stream(body, rid)
                     else:
@@ -2762,7 +3129,7 @@ class _StepEngine:
         st.gen = None
         st.buf = []
         qnow = _time.monotonic()  # same clock as PendingRequest.enqueued_at
-        s._m_queue_wait.observe(max(0.0, qnow - r.enqueued_at))
+        s._observe_queue_wait(r, max(0.0, qnow - r.enqueued_at))
         st.t_prev = _now()
         if r.trace is not None:
             r.trace.set_group(st.gid)
@@ -2803,7 +3170,7 @@ class _StepEngine:
             # restored prefix pages (ISSUE 17)
             kv.flush_restores()
             fn = s._prefill_chunk_fn(final, key.temperature, key.top_k)
-            out = fn(
+            pc_args = [
                 s.params,
                 kv.cache,
                 jnp.asarray(chunk),
@@ -2812,7 +3179,12 @@ class _StepEngine:
                 jnp.asarray(table),
                 jnp.asarray(seeds),
                 jnp.asarray(st.L + st.off, jnp.int32),
-            )
+            ]
+            if s._adapter_slots_active:
+                pc_args.append(
+                    jnp.asarray([r.adapter_slot], jnp.int32)
+                )
+            out = fn(*pc_args)
             if final:
                 kv.cache, first = out
             else:
@@ -2991,9 +3363,10 @@ class _StepEngine:
             traces=[r.trace for r in lane],
         )
         tables = kv.tables(plans, bb, wt)
+        ix = s._adapter_ix(lane, bb)
         with s._lock:
             fn = s._paged_step_fn(key0.temperature, key0.top_k, key0.eos_id)
-            kv.cache, nxt, done_out = fn(
+            step_args = [
                 s.params,
                 kv.cache,
                 jnp.asarray(tok),
@@ -3004,7 +3377,10 @@ class _StepEngine:
                 jnp.asarray(seeds),
                 jnp.asarray(pos, jnp.int32),
                 jnp.asarray(g, jnp.int32),
-            )
+            ]
+            if ix is not None:
+                step_args.append(jnp.asarray(ix))
+            kv.cache, nxt, done_out = fn(*step_args)
         nxt = np.asarray(nxt)
         done_out = np.asarray(done_out)
         chunk_cap = max(1, int(s.config.stream_chunk_tokens))
@@ -3081,11 +3457,12 @@ class _StepEngine:
             plans[:n], upto_slot=frontier, traces=[r.trace for r in lane]
         )
         tables = kv.tables(plans, bb, wt)
+        ix = s._adapter_ix(lane, bb)
         with s._lock:
             fn = s._spec_verify_paged_fn(
                 bb, K, L, wt, key0.temperature, key0.top_k, key0.eos_id
             )
-            kv.cache, targets, accept = fn(
+            sv_args = [
                 s.params,
                 kv.cache,
                 jnp.asarray(fed),
@@ -3095,7 +3472,10 @@ class _StepEngine:
                 jnp.asarray(seeds),
                 jnp.asarray(pos, jnp.int32),
                 jnp.asarray(start_g, jnp.int32),
-            )
+            ]
+            if ix is not None:
+                sv_args.append(jnp.asarray(ix))
+            kv.cache, targets, accept = fn(*sv_args)
         committed, done2, remaining2, eos_hit, delta = commit_window(
             fed, targets, accept, remaining, done, key0.eos_id
         )

@@ -118,6 +118,7 @@ class StepScheduler(DecodeCoalescer):
         max_queue: int = 64,
         breaker: Optional[CircuitBreaker] = None,
         observer: Optional[Callable[..., None]] = None,
+        tenancy=None,  # serving.tenancy.TenantAdmission (ISSUE 19)
     ):
         super().__init__(
             execute,
@@ -126,6 +127,7 @@ class StepScheduler(DecodeCoalescer):
             max_queue=max_queue,
             breaker=breaker,
             observer=observer,
+            tenancy=tenancy,
         )
         if prefill_chunk_tokens < 1:
             raise ValueError(
@@ -216,22 +218,32 @@ class StepScheduler(DecodeCoalescer):
     def _admit_active(self) -> None:
         """pending → active under the token budget: a row joins only while
         the steady decode cost of everything active (plus it) fits in
-        max_step_tokens. FIFO — rows that don't fit yet stay pending (and
+        max_step_tokens. FIFO — or, with tenancy configured, weighted
+        fair (smallest outstanding-tokens ÷ weight first, FIFO within a
+        tenant; ISSUE 19) — rows that don't fit yet stay pending (and
         still purge on expiry) until finishing rows free budget."""
         budget = self.max_step_tokens
         active_cost = sum(r.step.cost for r in self._decoding)
         active_cost += sum(self._row_cost(r) for r in self._prefilling)
         while self._pending:
-            r = self._pending[0]
+            if self.tenancy is not None and len(self._pending) > 1:
+                r = min(
+                    self._pending,
+                    key=lambda p: (
+                        self.tenancy.share(p.tenant), p.enqueued_at
+                    ),
+                )
+            else:
+                r = self._pending[0]
             if not self._engine.supports(r):
-                self._pending.popleft()
+                self._pending.remove(r)
                 self._classic.append(r)
                 continue
             cost = self._row_cost(r)
             if self._decoding or self._prefilling:
                 if active_cost + cost > budget:
                     break
-            self._pending.popleft()
+            self._pending.remove(r)
             try:
                 self._engine.begin(r)
             except BaseException as e:  # noqa: BLE001 — fail the row, not the loop

@@ -220,6 +220,27 @@ DEFAULT_SERVING_RULES: tuple[dict, ...] = (
         "window_s": 60.0,
         "threshold": 4.0,
     },
+    # multi-tenant serving (ISSUE 19): named-tenant queue waits doubling
+    # window-over-window means fairness is degrading (one tenant's flood
+    # is leaking into everyone's latency)...
+    {
+        "name": "tenant-queue-wait-trend",
+        "series": "serving.tenant_queue_wait_seconds",
+        "kind": "window_ratio",
+        "agg": "p95",
+        "window_s": 60.0,
+        "threshold": 2.0,
+    },
+    # ...and an adapter-load rate spike means the hot-slot working set is
+    # thrashing (too few adapterSlots for the live tenant mix)
+    {
+        "name": "adapter-thrash-surge",
+        "series": "serving.adapter_loads",
+        "kind": "window_ratio",
+        "agg": "rate",
+        "window_s": 60.0,
+        "threshold": 4.0,
+    },
 )
 
 

@@ -174,6 +174,21 @@ perf_counter/sleep` (and `_ns` variants) or `datetime.now/utcnow/today`
 call in those two files is forbidden: timestamps come in through
 `append(sample)`, evaluation time through the injected clock.
 
+Sixteenth rule: NO raw clock in tenancy admission or adapter
+residency. The per-tenant admission ledger
+(`polyaxon_tpu/serving/tenancy.py`) counts outstanding rows and queued
+tokens — pure occupancy, no ages — and the adapter registry
+(`polyaxon_tpu/serving/adapters.py`) orders LRU recency by a logical
+sequence counter, exactly like the spill tiers it demotes into (rule
+14). A raw `time.*()` / `datetime.now()` read in either would couple
+shed decisions and eviction order to host timing: the same tenant storm
+would shed different requests across runs, and the chaos replay (kill
+mid-restore → zero leak) would stop reproducing. Every duration the
+operator sees — per-tenant queue wait, adapter load time — is observed
+by the server layer on the telemetry clock. Any direct
+`time.time/monotonic/perf_counter/sleep` (and `_ns` variants) or
+`datetime.now/utcnow/today` call in those two files is forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -288,6 +303,17 @@ HISTORY_MODULES = (
     ("polyaxon_tpu", "telemetry", "history.py"),
     ("polyaxon_tpu", "telemetry", "detect.py"),
 )
+TENANCY_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: tenancy admission ledgers count outstanding rows/tokens and the
+#: adapter registry orders recency by a logical seq counter — no time
+#: axis, so per-tenant chaos replays stay deterministic (rule 16)
+TENANCY_MODULES = (
+    ("polyaxon_tpu", "serving", "tenancy.py"),
+    ("polyaxon_tpu", "serving", "adapters.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -347,6 +373,7 @@ def violations(repo_root: Path) -> list[str]:
         in_adaptive = rel.parts in ADAPTIVE_MODULES
         in_scenarios = rel.parts[:2] == ("polyaxon_tpu", "scenarios")
         in_spill = rel.parts in SPILL_MODULES
+        in_tenancy = rel.parts in TENANCY_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -425,6 +452,14 @@ def violations(repo_root: Path) -> list[str]:
                     f"— spill orders by logical sequence, the prefix "
                     f"directory by the last poll's advertisement; "
                     f"durations belong to the server layer: "
+                    f"{line.strip()}"
+                )
+            if in_tenancy and TENANCY_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in tenancy/adapter "
+                    f"residency — admission counts rows and tokens, "
+                    f"the registry orders recency by its logical seq; "
+                    f"queue-wait timing belongs to the server layer: "
                     f"{line.strip()}"
                 )
     return out

@@ -1633,6 +1633,171 @@ PY
       echo "HISTORY-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # tenancy gate (ISSUE 19): one multi-tenant server, two LoRA
+    # adapters squeezed through ONE hot slot plus quota'd tenants.
+    # Alternating tenants must force a real evict -> spill -> restore
+    # cycle that stays byte-identical (and identical to a solo
+    # single-adapter server), a capped noisy tenant's flood must shed
+    # with reason tenant_quota while the victim tenant completes every
+    # request, and the serving_adapter_* + per-tenant series must be
+    # live on /metricsz. A multiplexer that corrupts a restored
+    # adapter, sheds the wrong tenant, or serves dark FAILS.
+    echo "running tenancy smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.server import ModelServer
+from polyaxon_tpu.serving.tenancy import normalize_adapters, normalize_tenants
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128, "lora_rank": 4}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((2, 128), jnp.int32), train=False,
+)["params"]
+
+
+def serve(adapters, tenants, slots=0):
+    return ModelServer(
+        b.module, params,
+        config=ServingConfig(
+            max_batch=2, max_wait_ms=30.0,
+            adapters=normalize_adapters(adapters),
+            tenants=normalize_tenants(tenants),
+            adapter_slots=slots,
+        ),
+    )
+
+
+def post(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+GREEDY = {"tokens": [[1, 2, 3, 4, 5]], "maxNewTokens": 8,
+          "temperature": 0.0}
+server = serve(
+    {"acme": "seed:1", "globex": "seed:2"},
+    [{"name": "acme", "adapter": "acme"},
+     {"name": "globex", "adapter": "globex"},
+     {"name": "noisy", "max_outstanding": 1},
+     {"name": "victim"}],
+    slots=1,
+)
+port = server.start(port=0)
+try:
+    # 1) evict/restore byte identity: 2 adapters through 1 hot slot —
+    # every alternation swaps, the comeback must reproduce exact tokens
+    a1 = post(port, dict(GREEDY, tenant="acme"))[1]["tokens"]
+    g1 = post(port, dict(GREEDY, tenant="globex"))[1]["tokens"]
+    a2 = post(port, dict(GREEDY, tenant="acme"))[1]["tokens"]
+    if a1 != a2:
+        print("tenancy smoke: restored adapter diverged", a1, a2)
+        sys.exit(1)
+    if a1 == g1:
+        print("tenancy smoke: adapters did not diverge (vacuous)", a1)
+        sys.exit(1)
+    reg = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statsz", timeout=30
+    ).read())["tenancy"]["adapters"]
+    if reg["evictions"] < 1 or reg["restores"] < 1:
+        print("tenancy smoke: no real evict/restore cycle", reg)
+        sys.exit(1)
+    # 2) noisy flood sheds tenant_quota alone; victim completes all
+    results = []
+    lock = threading.Lock()
+
+    def noisy(i):
+        s, p = post(port, {"tokens": [[1, 2]], "maxNewTokens": 16,
+                           "tenant": "noisy", "seed": i,
+                           "temperature": 0.5, "topK": 10})
+        with lock:
+            results.append((s, p.get("reason")))
+
+    threads = [threading.Thread(target=noisy, args=(i,), daemon=True)
+               for i in range(5)]
+    for t in threads:
+        t.start()
+    for i in range(3):
+        s, p = post(port, {"tokens": [[3, 4, 5]], "maxNewTokens": 4,
+                           "tenant": "victim"})
+        if s != 200:
+            print("tenancy smoke: victim request failed", s, p)
+            sys.exit(1)
+    for t in threads:
+        t.join(300)
+    sheds = [r for r in results if r[0] == 503]
+    if not sheds:
+        print("tenancy smoke: flood never overran the cap", results)
+        sys.exit(1)
+    if any(r[1] != "tenant_quota" for r in sheds):
+        print("tenancy smoke: shed with wrong reason", results)
+        sys.exit(1)
+    ten = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statsz", timeout=30
+    ).read())["tenancy"]["tenants"]
+    if ten["victim"]["shed"] != 0 or ten["noisy"]["shed"] != len(sheds):
+        print("tenancy smoke: shed ledger misattributed", ten)
+        sys.exit(1)
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metricsz", timeout=30
+    ).read().decode()
+finally:
+    server.stop()
+# 3) mixed-tenant output matches a solo single-adapter server
+solo = serve({"acme": "seed:1"}, [{"name": "acme", "adapter": "acme"}])
+sport = solo.start(port=0)
+try:
+    s1 = post(sport, dict(GREEDY, tenant="acme"))[1]["tokens"]
+finally:
+    solo.stop()
+if s1 != a1:
+    print("tenancy smoke: mixed-tenant output != solo server", a1, s1)
+    sys.exit(1)
+with open("tpu_results/tenancy_metricsz_tpu.txt", "w") as f:
+    f.write(text)
+required = (
+    "serving_adapter_resident",
+    "serving_adapter_loads_total",
+    "serving_adapter_evictions_total",
+    "serving_adapter_restores_total",
+    "serving_adapter_load_ms",
+    "serving_tenant_queue_wait_seconds",
+    "serving_shed_by_tenant_noisy_total",
+    "serving_queue_wait_by_tenant_victim",
+)
+missing = [s for s in required if s not in text]
+if missing:
+    print("tenancy smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"tenancy smoke: ok ({len(required)} required series present, "
+      f"{reg['evictions']} evictions / {reg['restores']} restores "
+      f"byte-identical, {len(sheds)} noisy sheds all tenant_quota, "
+      f"victim untouched, solo-identity holds)")
+PY
+    then
+      echo "TENANCY-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

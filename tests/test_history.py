@@ -577,7 +577,7 @@ def test_build_rules_validation():
     rules = build_rules(DEFAULT_SERVING_RULES)
     assert [r.name for r in rules] == [
         "ttft-creep", "queue-wait-trend", "accept-rate-collapse",
-        "kv-spill-surge",
+        "kv-spill-surge", "tenant-queue-wait-trend", "adapter-thrash-surge",
     ]
     with pytest.raises(ValueError, match="duplicate"):
         build_rules(
