@@ -221,7 +221,8 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float,
                  lora_rank: int = 0,
                  adapters: dict | None = None,
                  tenants: list | None = None,
-                 adapter_slots: int = 0):
+                 adapter_slots: int = 0,
+                 role: str = "both"):
     import jax
     import jax.numpy as jnp
 
@@ -254,6 +255,7 @@ def build_server(batching: bool, max_batch: int, max_wait_ms: float,
             adapters=normalize_adapters(adapters or {}),
             tenants=normalize_tenants(tenants or []),
             adapter_slots=adapter_slots,
+            role=role,
         ),
         history=history,
     )
@@ -1015,6 +1017,173 @@ def drive_interference(rounds: int, shorts_per_round: int, max_batch: int,
     }
 
 
+def drive_disaggregated(rounds: int, shorts_per_round: int, max_batch: int,
+                        max_wait_ms: float, seed: int, smoke: bool) -> dict:
+    """ISSUE 20 record: the PR 14 interference cohort across a
+    disaggregated prefill/decode split, plus the cost of the split
+    itself — live KV handoff latency.
+
+    The same mixed-length traffic runs twice behind a router: a
+    2-replica monolithic chunked fleet, then a 1 prefill + 1 decode
+    pooled pair. On the pooled pair every request's finished prefill
+    pages ship over POST /kv_import (CRC-framed spill-segment bytes,
+    single-owner leases) and decode continues on the other replica — the
+    long prompt's slices never share a step budget with the shorts'
+    decode rows. The headline value is the handoff latency p95 as the
+    prefill replicas observed it (`serving_kv_handoff_ms`): the transfer
+    is the tax the split pays, and it must stay small against the
+    prefill time it hides.
+
+      {"metric": "serving_disaggregated_handoff_p95_ms", "value": ...,
+       "unit": "ms", "ttft_short_p95_pooled_ms": ...,
+       "ttft_short_p95_monolithic_ms": ..., "handoff_exports": ...,
+       "handoff_fallbacks": ..., "byte_identical": bool,
+       "gate_enforced": bool}
+
+    Mechanism gates hold everywhere: real handoffs happened (exports and
+    imports counted, zero fallbacks — a pooled pair that quietly decodes
+    monolithically is not evidence), every lease completed, and a pinned
+    greedy request answers byte-identically on both fleets. The latency
+    gate needs cores (the timing clients and four servers contend on a
+    1-core host, same physics as --interference), so it is enforced only
+    when `gate_enforced`.
+    """
+    import os
+
+    import jax
+
+    from polyaxon_tpu.serving.router import P2CBalancer, Router
+
+    rng = random.Random(seed)
+    long_len, short_len = 96, 12  # 12 / 1 full 8-token pages to hand off
+    vocab = MODEL_CFG["vocab_size"]
+    long_prompt = [rng.randrange(vocab) for _ in range(long_len)]
+    short_prompts = [
+        [rng.randrange(vocab) for _ in range(short_len)]
+        for _ in range(rounds * shorts_per_round)
+    ]
+
+    def body(tokens: list[int], new: int, s: int) -> dict:
+        return {"tokens": [tokens], "maxNewTokens": new,
+                "temperature": 0.8, "topK": 40, "seed": s}
+
+    kw = dict(kv_pool_pages=96, kv_page_tokens=8, chunked_prefill=True,
+              prefill_chunk_tokens=16, max_step_tokens=64)
+    sides = {}
+    ledgers = {}
+    raw = {}
+    for label, roles in (("monolithic", ("both", "both")),
+                         ("pooled", ("prefill", "decode"))):
+        servers = [
+            build_server(True, max_batch, max_wait_ms, role=r, **kw)
+            for r in roles
+        ]
+        ports = [s.start(port=0) for s in servers]
+        router = Router(
+            [f"http://127.0.0.1:{p}" for p in ports],
+            balancer=P2CBalancer(seed=seed + 7), poll_interval_s=0.1,
+        )
+        rport = router.start(port=0)
+        try:
+            # the pooled dispatch needs the scraped roles before the
+            # first request, or the long prompt lands on the decode pool
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                router.poll_once()
+                reps = router.stats()["replicas"]
+                if len(reps) == 2 and all(r["healthy"] for r in reps):
+                    break
+                time.sleep(0.1)
+            url = f"http://127.0.0.1:{rport}/generate"
+            # warm through the router: compiles (and on the pooled side
+            # the export/adopt paths) stay out of the timed rounds
+            _post(url, body(long_prompt, 32, 0))
+            _stream_ttft("127.0.0.1", rport, body(short_prompts[0], 4, 0))
+
+            ttfts: list[float] = []
+            for r in range(rounds):
+                done = threading.Event()
+
+                def fire_long():
+                    _post(url, body(long_prompt, 32, 100 + r))
+                    done.set()
+
+                t = threading.Thread(target=fire_long, daemon=True)
+                t.start()
+                time.sleep(0.01)  # let the long request enter the worker
+                for i in range(shorts_per_round):
+                    ttft, _ = _stream_ttft(
+                        "127.0.0.1", rport,
+                        body(short_prompts[r * shorts_per_round + i], 4,
+                             200 + r * shorts_per_round + i),
+                    )
+                    ttfts.append(ttft * 1000.0)
+                done.wait(timeout=300.0)
+            # identity probe: same pinned rid on both fleets must answer
+            # the same bytes — the split may not change a single token
+            raw[label] = _raw_post(
+                f"http://127.0.0.1:{rport}",
+                body(long_prompt[:24], 8, 0) | {"temperature": 0.0},
+                rid="disagg-identity",
+            )
+            sides[label] = ttfts
+            if label == "pooled":
+                pre, dec = servers
+                h = pre._m_handoff_ms
+                ledgers["handoff_p95_ms"] = h.percentile(0.95)
+                ledgers["handoff_p50_ms"] = h.percentile(0.5)
+                ledgers["handoff_transfers"] = h.count
+                ledgers["exports"] = pre.stats()["handoff"]["exports"]
+                ledgers["fallbacks"] = pre.stats()["handoff"]["fallbacks"]
+                ledgers["imports"] = dec.stats()["handoff"]["imports"]
+                lease = dec.stats()["handoff"]["leases"]
+                ledgers["lease_granted"] = lease["granted"]
+                ledgers["lease_completed"] = lease["completed"]
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    p95_pooled = quantile(sides["pooled"], 0.95)
+    p95_mono = quantile(sides["monolithic"], 0.95)
+    cores = len(os.sched_getaffinity(0))
+    device = jax.devices()[0]
+    p95 = ledgers.get("handoff_p95_ms")
+    return {
+        "metric": "serving_disaggregated_handoff_p95_ms",
+        "value": round(p95, 2) if p95 is not None else None,
+        "unit": "ms",
+        "handoff_p50_ms": (
+            round(ledgers["handoff_p50_ms"], 2)
+            if ledgers.get("handoff_p50_ms") is not None else None
+        ),
+        "handoff_transfers": ledgers.get("handoff_transfers", 0),
+        "handoff_exports": ledgers.get("exports", 0),
+        "handoff_imports": ledgers.get("imports", 0),
+        "handoff_fallbacks": ledgers.get("fallbacks", 0),
+        "lease_granted": ledgers.get("lease_granted", 0),
+        "lease_completed": ledgers.get("lease_completed", 0),
+        "ttft_short_p50_pooled_ms": round(
+            quantile(sides["pooled"], 0.5), 1),
+        "ttft_short_p50_monolithic_ms": round(
+            quantile(sides["monolithic"], 0.5), 1),
+        "ttft_short_p95_pooled_ms": round(p95_pooled, 1),
+        "ttft_short_p95_monolithic_ms": round(p95_mono, 1),
+        "byte_identical": raw["pooled"] == raw["monolithic"],
+        "long_prompt_tokens": long_len,
+        "short_prompt_tokens": short_len,
+        "short_requests": len(sides["pooled"]),
+        "rounds": rounds,
+        "host_cores": cores,
+        # 1-core hosts bury the handoff timing (and any phase-isolation
+        # win) under CPU contention between the timing clients and four
+        # servers — report honestly, gate only where it can express
+        "gate_enforced": cores >= 2,
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+
+
 def drive_affinity(max_batch: int, max_wait_ms: float, seed: int,
                    smoke: bool) -> dict:
     """ISSUE 17 record: cluster-wide warm KV — affinity routing and the
@@ -1715,6 +1884,11 @@ def main(argv=None):
                     help="run the ISSUE 14 chunked-prefill record: short-"
                          "request TTFT under a long-prompt mix, chunked "
                          "step scheduler vs one-blocking-execute")
+    ap.add_argument("--disaggregated", action="store_true",
+                    help="run the ISSUE 20 record: the interference "
+                         "cohort across a prefill/decode pooled pair vs "
+                         "a monolithic fleet, gated on live KV handoff "
+                         "latency p95 and byte-identity across the split")
     ap.add_argument("--router", action="store_true",
                     help="run the ISSUE 10 horizontal-serving records "
                          "(replica processes behind serving/router.py) "
@@ -1815,6 +1989,29 @@ def main(argv=None):
         )
         if args.smoke and rec["gate_enforced"]:
             if (rec["value"] or 0) < 1.2 or (rec["restore_speedup"] or 0) < 1.0:
+                ok = False
+        return 0 if ok else 1
+
+    if args.disaggregated:
+        rounds, shorts = (2, 3) if args.smoke else (4, 4)
+        rec = drive_disaggregated(
+            rounds, shorts, args.max_batch, args.max_wait_ms, args.seed,
+            args.smoke,
+        )
+        print(json.dumps(rec), flush=True)
+        # mechanism gates hold everywhere: the pooled pair must have run
+        # REAL handoffs (a pair that quietly decodes monolithically is
+        # not evidence), every lease must have completed, and the split
+        # may not change a byte; the latency gate needs cores
+        ok = (
+            rec["handoff_exports"] >= 1
+            and rec["handoff_imports"] >= 1
+            and rec["handoff_fallbacks"] == 0
+            and rec["lease_completed"] >= 1
+            and rec["byte_identical"]
+        )
+        if args.smoke and rec["gate_enforced"]:
+            if rec["value"] is None or rec["value"] > 250.0:
                 ok = False
         return 0 if ok else 1
 

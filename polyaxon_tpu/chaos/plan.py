@@ -298,6 +298,31 @@ class FaultPlan:
             params={"kill_tick": k, "kill_slot": slot, "window": window},
         )
 
+    @classmethod
+    def kv_handoff_crash(
+        cls, seed: int, window: int = 4, action: str = "raise"
+    ) -> "FaultPlan":
+        """A fault lands in a seed-chosen window of the live KV handoff
+        (ISSUE 20): export capture/send on the prefill side, import
+        parse on the decode side, or the adopt itself. Whatever the
+        window, the invariant is the same — zero pages leaked on either
+        replica, and the request still completes byte-identical, via a
+        clean transfer retry or the prefill replica's monolithic
+        fallback. The hit index is seed-chosen so repeated runs walk
+        different handoffs."""
+        rng = random.Random(f"kv_handoff_crash:{seed}")
+        point = rng.choice(
+            ["serving.kv_export", "serving.kv_import", "serving.kv_adopt"]
+        )
+        k = rng.randrange(0, max(1, window))
+        return cls(
+            [Fault(point, action, at=k,
+                   message=f"chaos: handoff fault at {point} #{k}")],
+            seed=seed,
+            params={"fault_point": point, "fault_hit": k,
+                    "fault_action": action},
+        )
+
     # ------------------------------------------- event-log store scenarios
     # The store points (ISSUE 11): `store.append` fires right before a
     # batch's frames hit the run's live segment (ctx: run, seq, path),

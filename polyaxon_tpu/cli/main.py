@@ -1047,6 +1047,19 @@ def agent_drain(queues):
               help="run N replica processes as a fleet-placed gang behind "
                    "the router (default: the run spec's serving.replicas, "
                    "else 1)")
+@click.option("--role", default=None,
+              type=click.Choice(["both", "prefill", "decode"]),
+              help="serving role for this replica: 'prefill' runs only "
+                   "chunked-prefill steps and live-hands the KV page set "
+                   "to a decode replica over POST /kv_import (requires "
+                   "--chunked-prefill + --kv-pool-pages + prefix cache); "
+                   "'decode' advertises itself as an adoption target; "
+                   "'both' (default) is the monolithic server")
+@click.option("--pools", default=None, metavar="PREFILL:DECODE",
+              help="fleet mode: disaggregate into PREFILL prefill-only "
+                   "replicas plus DECODE decode replicas behind the "
+                   "router (implies --route; default: the run spec's "
+                   "serving.pools)")
 @click.option("--mesh-model", default=None, type=int,
               help="shorthand for --mesh model=N: tensor-parallel the "
                    "projection kernels over N chips per replica")
@@ -1065,7 +1078,7 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
           no_chunked_prefill, prefill_chunk_tokens, max_step_tokens,
           spill_ram_bytes, spill_dir, spill_dir_bytes, adapter_specs,
           tenant_specs, adapter_slots, no_affinity,
-          no_trace, replicas, mesh_model, route, autoscale_max):
+          no_trace, replicas, role, pools, mesh_model, route, autoscale_max):
     """Serve a checkpointed LM run's generation over HTTP
     (GET /healthz, GET /readyz, GET /statsz, POST /generate)."""
     from ..serving import ModelServer
@@ -1204,10 +1217,30 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
         ("spill_ram_bytes", spill_ram_bytes),
         ("spill_dir", spill_dir),
         ("spill_dir_bytes", spill_dir_bytes),
+        ("role", role),
     ):
         if value is not None:
             overrides[field] = value
-    if route or (replicas or 0) > 1:
+    pool_counts = None
+    if pools:
+        try:
+            p, _, d = pools.partition(":")
+            pool_counts = (int(p), int(d))
+            if min(pool_counts) < 0 or sum(pool_counts) < 1:
+                raise ValueError
+        except ValueError:
+            raise click.ClickException(
+                f"--pools expects PREFILL:DECODE counts, got {pools!r}"
+            )
+    # a run whose spec declares serving.pools must come up disaggregated
+    # without any CLI opt-in — `serve --uid` promises the shape the spec
+    # pinned, and a silently-monolithic pooled run honors neither role
+    spec_wants_pools = (
+        pool_counts is None and not route and (replicas or 0) <= 1
+        and role is None and _run_spec_pools(uid) is not None
+    )
+    if route or (replicas or 0) > 1 or pool_counts is not None \
+            or spec_wants_pools:
         _serve_fleet(
             uid, host, port,
             replicas=replicas,
@@ -1216,6 +1249,7 @@ def serve(uid, host, port, mesh, max_batch, max_wait_ms, buckets, no_batching,
             expected_devices=expected_devices,
             autoscale_max=autoscale_max,
             no_affinity=no_affinity,
+            pools=pool_counts,
         )
         return
     try:
@@ -1275,6 +1309,7 @@ _SERVE_FLAG_SPELLING = {
     "spill_ram_bytes": "--spill-ram-bytes",
     "spill_dir_bytes": "--spill-dir-bytes",
     "adapter_slots": "--adapter-slots",
+    "role": "--role",
 }
 
 
@@ -1331,8 +1366,33 @@ def _serve_child_argv(uid, port, mesh_axes, overrides, expected_devices):
     return argv
 
 
+def _run_spec_pools(uid):
+    """(prefill, decode) from the run spec's serving.pools, or None —
+    unresolved uids and template-valued counts fall through to the
+    monolithic path, whose own error reporting is better placed."""
+    try:
+        from ..schemas.run_kinds import V1JAXJob
+
+        store = RunStore()
+        run = (
+            store.read_spec(store.resolve(uid)).get("component") or {}
+        ).get("run") or {}
+        if run.get("kind") != "jaxjob" or not run.get("program"):
+            return None
+        spec = V1JAXJob.model_validate(run).program.serving
+        ps = spec.pools if spec is not None else None
+        if ps is None or not (
+            isinstance(ps.prefill, int) and isinstance(ps.decode, int)
+        ):
+            return None
+        return (int(ps.prefill), int(ps.decode))
+    except Exception:
+        return None
+
+
 def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
-                 expected_devices, autoscale_max, no_affinity=False):
+                 expected_devices, autoscale_max, no_affinity=False,
+                 pools=None):
     """`polyaxon serve --replicas N --route`: N single-replica children
     as a fleet-placed gang, fronted by the JSQ/P2C router."""
     from ..scheduler.fleet import Fleet
@@ -1355,11 +1415,22 @@ def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
             serving_spec = V1JAXJob.model_validate(run).program.serving
     except Exception:
         pass
-    n = replicas or (
-        int(serving_spec.replicas)
-        if serving_spec is not None and isinstance(serving_spec.replicas, int)
-        else 1
-    )
+    # disaggregated pools (ISSUE 20): slots [0, n_prefill) run prefill-
+    # only replicas, the rest decode; the CLI --pools wins over the run
+    # spec's serving.pools
+    if pools is None and serving_spec is not None and serving_spec.pools:
+        ps = serving_spec.pools
+        if isinstance(ps.prefill, int) and isinstance(ps.decode, int):
+            pools = (int(ps.prefill), int(ps.decode))
+    if pools is not None:
+        n = pools[0] + pools[1]
+    else:
+        n = replicas or (
+            int(serving_spec.replicas)
+            if serving_spec is not None
+            and isinstance(serving_spec.replicas, int)
+            else 1
+        )
     if mesh_axes is None and serving_spec is not None:
         mesh_axes = serving_spec.mesh_axes
     chips = 1
@@ -1370,9 +1441,15 @@ def _serve_fleet(uid, host, port, *, replicas, mesh_axes, overrides,
         chips = _math.prod(sizes) if sizes else 1
 
     def factory(i):
+        slot_overrides = overrides
+        if pools is not None:
+            # slots past the declared pools (autoscale growth) decode:
+            # decode capacity is the safe direction to grow
+            slot_role = "prefill" if i < pools[0] else "decode"
+            slot_overrides = {**overrides, "role": slot_role}
         return SubprocessReplica(
             lambda p: _serve_child_argv(
-                uuid, p, mesh_axes, overrides, expected_devices
+                uuid, p, mesh_axes, slot_overrides, expected_devices
             )
         )
 

@@ -89,7 +89,12 @@ class Scenario:
     assertions: Assertions
     smoke_params: dict = dataclasses.field(default_factory=dict)
     serving_overrides: dict = dataclasses.field(default_factory=dict)
-    chaos: Optional[str] = None  # "replica_kill" | None
+    chaos: Optional[str] = None  # "replica_kill" | "prefill_pool_kill"
+    # disaggregated pools (ISSUE 20): (n_prefill, n_decode). The rig's
+    # first `n_prefill` slots run role="prefill" (chunked prefill +
+    # prefix cache, shipping finished page sets over /kv_import) and
+    # the rest role="decode". None = monolithic replicas.
+    pools: Optional[tuple] = None
     twin_config: dict = dataclasses.field(default_factory=dict)
     twin_only: bool = False
     # stamp each record's tenant into its request body (requires the
@@ -175,6 +180,30 @@ _register(Scenario(
     assertions=Assertions(
         max_shed_rate=0.5, max_error_rate=0.10, min_completed=8,
     ),
+))
+
+_register(Scenario(
+    name="prefill_pool_outage",
+    description="Disaggregated 1+1 pools (prefill ships live KV to "
+                "decode over /kv_import); the WHOLE prefill pool dies "
+                "mid-soak — the router degrades to monolithic decode-"
+                "pool serving, in-flight handoffs fall back or retry, "
+                "and nothing hangs or leaks on either side.",
+    generator="diurnal",
+    params=dict(n=160, duration_s=16.0, base_rps=10.0, max_prompt=24),
+    smoke_params=dict(n=36, duration_s=6.0, base_rps=6.0, max_prompt=24),
+    chaos="prefill_pool_kill",
+    pools=(1, 1),
+    assertions=Assertions(
+        # the kill window costs at most the in-flight requests on the
+        # dying prefill replica (same tolerance replica_kill_midsoak
+        # carries); everything after degrades to the decode pool
+        max_shed_rate=0.5, max_error_rate=0.10, min_completed=8,
+    ),
+    # twin mirror: the two-pool handoff-cost model (prefill pool
+    # services prefill only, one handoff_ms per row to the decode pool,
+    # local fallback when the decode pool cannot adopt)
+    twin_config=dict(pools=(1, 1)),
 ))
 
 _register(Scenario(
@@ -304,7 +333,8 @@ class Rig:
 
 
 def build_rig(replicas: int = 2, overrides: Optional[dict] = None,
-              slos: Optional[list] = None) -> Rig:
+              slos: Optional[list] = None,
+              pools: Optional[tuple] = None) -> Rig:
     import jax
     import jax.numpy as jnp
 
@@ -342,15 +372,34 @@ def build_rig(replicas: int = 2, overrides: Optional[dict] = None,
     if slos is None:
         slos = [{"name": "availability", "kind": "availability",
                  "objective": 0.99}]
+    # disaggregated pools (ISSUE 20): slots [0, n_prefill) run
+    # role="prefill" (which requires chunked prefill + the prefix cache
+    # — the handoff unit is the page-aligned prefix chain), the rest
+    # role="decode" (prefix cache on so /kv_import has somewhere to
+    # adopt pages). The slot-indexed factory keeps roles stable across
+    # monitor restarts.
+    if pools is not None:
+        n_prefill = max(1, int(pools[0]))
+        replicas = n_prefill + max(1, int(pools[1]))
 
-    def _server():
+    def _cfg_for(slot: int) -> ServingConfig:
+        if pools is None:
+            return cfg
+        if slot < n_prefill:
+            return dataclasses.replace(
+                cfg, role="prefill", chunked_prefill=True,
+                prefix_cache=True,
+            )
+        return dataclasses.replace(cfg, role="decode", prefix_cache=True)
+
+    def _server(slot: int = -1):
         return ModelServer(
-            bundle.module, params, model_name="scenario-rig", config=cfg,
-            slos=slos,
+            bundle.module, params, model_name="scenario-rig",
+            config=_cfg_for(slot), slos=slos,
         )
 
     mgr = ReplicaSetManager(
-        lambda i: InProcessReplica(_server),
+        lambda i: InProcessReplica(lambda slot=i: _server(slot)),
         replicas=replicas,
         retry=RetryPolicy(max_retries=3, backoff=0.05),
         monitor_interval_s=0.1,
@@ -389,8 +438,11 @@ def _wait_drained(rig: Rig, budget_s: float = 20.0) -> list[str]:
             # pages the prefix cache keeps on purpose are warm state,
             # not in-flight work — a warm rig still counts as drained
             held = snap.value("serving_kv_pages_prefix_held", 0.0)
+            # an export in flight is work, not warmth: a prefill replica
+            # mid-handoff must never report drained (ISSUE 20)
             if (
                 snap.value("serving_queue_depth", 0.0) > 0
+                or snap.value("serving_kv_handoff_inflight", 0.0) > 0
                 or snap.value("serving_kv_pages_used", 0.0) > 1 + held
             ):
                 busy = True
@@ -536,19 +588,29 @@ def _records(scn: Scenario, smoke: bool, seed: Optional[int]):
 
 def _twin_faults(scn: Scenario, seed: int, duration_s: float,
                  replicas: int) -> list[dict]:
-    if scn.chaos != "replica_kill":
-        return []
-    plan = FaultPlan.replica_kill_midsoak(
-        seed, window=max(2, int(duration_s / _CHAOS_TICK_S)),
-        replicas=replicas,
-    )
-    return [{
-        "kind": "replica_down",
-        "replica": plan.params["kill_slot"],
-        "at_s": plan.params["kill_tick"] * _CHAOS_TICK_S,
-        # the monitor's restart latency, scaled into sim time
-        "duration_s": 1.0,
-    }]
+    window = max(2, int(duration_s / _CHAOS_TICK_S))
+    if scn.chaos == "replica_kill":
+        plan = FaultPlan.replica_kill_midsoak(seed, window=window,
+                                              replicas=replicas)
+        return [{
+            "kind": "replica_down",
+            "replica": plan.params["kill_slot"],
+            "at_s": plan.params["kill_tick"] * _CHAOS_TICK_S,
+            # the monitor's restart latency, scaled into sim time
+            "duration_s": 1.0,
+        }]
+    if scn.chaos == "prefill_pool_kill":
+        # the whole prefill pool dies at one seed-chosen tick (ISSUE 20)
+        n_prefill = max(1, int((scn.pools or (1, 1))[0]))
+        plan = FaultPlan.replica_kill_midsoak(seed, window=window,
+                                              replicas=n_prefill)
+        at_s = plan.params["kill_tick"] * _CHAOS_TICK_S
+        return [
+            {"kind": "replica_down", "replica": slot, "at_s": at_s,
+             "duration_s": 1.0}
+            for slot in range(n_prefill)
+        ]
+    return []
 
 
 def run_twin(scn: Scenario, *, smoke: bool = False,
@@ -598,29 +660,39 @@ def run_real(scn: Scenario, *, smoke: bool = False,
     use_seed = scn.seed if seed is None else seed
     own_rig = rig is None
     if own_rig:
-        rig = build_rig(replicas=replicas, overrides=scn.serving_overrides)
+        rig = build_rig(replicas=replicas, overrides=scn.serving_overrides,
+                        pools=scn.pools)
     stop_chaos = threading.Event()
     chaos_thread = None
     chaos_params = {}
     try:
-        if scn.chaos == "replica_kill":
+        if scn.chaos in ("replica_kill", "prefill_pool_kill"):
             horizon = float(params.get("duration_s", 10.0))
-            plan = FaultPlan.replica_kill_midsoak(
-                use_seed,
-                window=max(2, int(horizon / _CHAOS_TICK_S)),
-                replicas=rig.replicas,
-            )
-            chaos_params = dict(plan.params)
-            slot = plan.params["kill_slot"]
+            window = max(2, int(horizon / _CHAOS_TICK_S))
+            if scn.chaos == "replica_kill":
+                plan = FaultPlan.replica_kill_midsoak(
+                    use_seed, window=window, replicas=rig.replicas,
+                )
+                kill_slots = [plan.params["kill_slot"]]
+            else:
+                # the WHOLE prefill pool dies together (ISSUE 20): the
+                # seed picks the tick, the pool picks the slots
+                n_prefill = max(1, int((scn.pools or (1, 1))[0]))
+                plan = FaultPlan.replica_kill_midsoak(
+                    use_seed, window=window, replicas=n_prefill,
+                )
+                kill_slots = list(range(n_prefill))
+            chaos_params = dict(plan.params, kill_slots=kill_slots)
 
             def _tick():
                 while not stop_chaos.wait(_CHAOS_TICK_S):
                     fault = plan.fire("scenario.replica_kill")
                     if fault is not None and fault.action == "kill":
-                        try:
-                            rig.mgr.replica(slot).kill()
-                        except Exception:  # noqa: BLE001 — already dead is fine
-                            pass
+                        for slot in kill_slots:
+                            try:
+                                rig.mgr.replica(slot).kill()
+                            except Exception:  # noqa: BLE001 — already dead is fine
+                                pass
 
             chaos_thread = threading.Thread(target=_tick, daemon=True)
             chaos_thread.start()

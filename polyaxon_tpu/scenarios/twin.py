@@ -56,6 +56,10 @@ class PhaseCosts:
     prefill_ms_per_token: float = 0.08
     decode_step_ms: float = 2.0
     batch_overhead_ms: float = 4.0
+    # disaggregated pools (ISSUE 20): per-row prefill→decode KV
+    # transfer cost (serialize + POST /kv_import + verify + adopt) —
+    # the serving_kv_handoff_ms histogram is its real-stack mirror
+    handoff_ms: float = 1.5
 
     @classmethod
     def fit(cls, metricsz_texts, mean_prompt_tokens: float,
@@ -138,14 +142,29 @@ class TwinConfig:
     # TenantAdmission (whose caps are per replica — a twin modeling an
     # N-replica rig should multiply accordingly). None/missing = uncapped.
     tenants: Optional[dict] = None
+    # ISSUE 20: disaggregated pools — (n_prefill, n_decode). When set
+    # the replica list is prefill slots then decode slots and `replicas`
+    # is ignored: fresh rows admit to the prefill pool (decode pool as
+    # monolithic fallback when every prefill replica is down), a
+    # prefill batch services ONLY its prefill region, then every row
+    # pays `handoff_ms` to move its page set to the least-loaded decode
+    # replica; a decode pool that cannot adopt (down, queue-full, or
+    # page-starved) sends the row back to the prefill pool for local
+    # monolithic decode — the real stack's kv_handoff fallback.
+    pools: Optional[tuple] = None
 
 
 class _Row:
     __slots__ = ("i", "arrive_t", "prompt_len", "max_new", "deadline",
                  "disconnect_after_ms", "pages", "attempts", "prefix_group",
-                 "tenant")
+                 "tenant", "decode_phase", "ttft_ms")
 
     def __init__(self, rec: TraceRequest, arrive_t: float, pages: int):
+        # disaggregated handoff (ISSUE 20): True once the row's prefill
+        # finished on a prefill replica (TTFT recorded then) — only the
+        # decode region remains wherever it lands next
+        self.decode_phase = False
+        self.ttft_ms: Optional[float] = None
         self.i = rec.i
         self.tenant = rec.tenant or "default"
         self.arrive_t = arrive_t
@@ -211,13 +230,23 @@ class ServingTwin:
         self.cfg = cfg
         self.costs = costs
         self.clock = SimClock()
-        self.replicas = [_Replica() for _ in range(cfg.replicas)]
+        # disaggregated pools (ISSUE 20): prefill slots first, then
+        # decode slots; n_prefill == 0 means monolithic replicas
+        if cfg.pools is not None:
+            self.n_prefill = max(1, int(cfg.pools[0]))
+            n_replicas = self.n_prefill + max(1, int(cfg.pools[1]))
+        else:
+            self.n_prefill = 0
+            n_replicas = cfg.replicas
+        self.replicas = [_Replica() for _ in range(n_replicas)]
+        self.handoffs = 0
+        self.handoff_fallbacks = 0
         self._events: list[tuple[float, int, str, object]] = []
         self._seq = 0
         for f in faults:
             if f.get("kind") != "replica_down":
                 raise ValueError(f"unknown twin fault kind: {f!r}")
-            r = int(f["replica"]) % cfg.replicas
+            r = int(f["replica"]) % n_replicas
             t = float(f["at_s"])
             self._push(t, "down", r)
             self._push(t + float(f.get("duration_s", 1.0)), "up", r)
@@ -271,6 +300,22 @@ class ServingTwin:
             self._tenant_out[tenant] -= 1
 
     # ---------------------------------------------------------- routing
+    def _role_up(self, prefill: bool) -> list[int]:
+        """Live slot indices of one pool (pooled mode only)."""
+        rng = (range(self.n_prefill) if prefill
+               else range(self.n_prefill, len(self.replicas)))
+        return [i for i in rng if self.replicas[i].up]
+
+    def _route_order(self) -> list[int]:
+        """JSQ candidate order. Pooled mode sends fresh rows to the
+        prefill pool; a fully-dead prefill pool degrades to routing the
+        decode pool monolithically (the router's role-aware reorder)."""
+        if self.n_prefill:
+            pool = self._role_up(True) or self._role_up(False)
+        else:
+            pool = [i for i, r in enumerate(self.replicas) if r.up]
+        return sorted(pool, key=lambda i: self.replicas[i].depth())
+
     def _admit(self, rec: TraceRequest, now: float) -> None:
         self.offered += 1
         tenant = rec.tenant or "default"
@@ -291,10 +336,7 @@ class ServingTwin:
         if self.cfg.kv_pool_pages:
             pages = -(-(rec.prompt_len + rec.max_new) // self.cfg.kv_page_tokens)
         row = _Row(rec, now, pages)
-        order = sorted(
-            (i for i, r in enumerate(self.replicas) if r.up),
-            key=lambda i: self.replicas[i].depth(),
-        )
+        order = self._route_order()
         # prefix affinity (ISSUE 17): a row whose cohort some replica's
         # directory already holds goes there first — the twin models the
         # router's stickiness without the imbalance yield (at twin scale
@@ -335,12 +377,14 @@ class ServingTwin:
 
     def _requeue(self, row: _Row, now: float) -> None:
         """Failover: a dying replica's row retries on a sibling, keeping
-        its original arrival time (the client pays for the redo)."""
+        its original arrival time (the client pays for the redo). The
+        retry is a FULL replay — a decode-phase row's adopted pages died
+        with the replica, so the new owner prefills from scratch, just
+        like the router re-posting the whole body."""
         row.attempts += 1
-        order = sorted(
-            (i for i, r in enumerate(self.replicas) if r.up),
-            key=lambda i: self.replicas[i].depth(),
-        )
+        row.decode_phase = False
+        row.ttft_ms = None
+        order = self._route_order()
         for i in order:
             rep = self.replicas[i]
             if rep.depth() >= self.cfg.max_queue:
@@ -379,10 +423,17 @@ class ServingTwin:
             break
         if not rep.queue:
             return
-        batch = [
-            rep.queue.popleft()
-            for _ in range(min(self.cfg.max_batch, len(rep.queue)))
-        ]
+        # phase-uniform batches (ISSUE 20): decode-phase continuations
+        # (adopted handoffs, local fallbacks) never share a batch with
+        # fresh prefills — the real step engine separates the phases too
+        head_phase = rep.queue[0].decode_phase
+        batch = []
+        while (
+            rep.queue
+            and len(batch) < self.cfg.max_batch
+            and rep.queue[0].decode_phase == head_phase
+        ):
+            batch.append(rep.queue.popleft())
         steps = 0
         for row in batch:
             eff = row.max_new
@@ -394,6 +445,13 @@ class ServingTwin:
                     1 + math.ceil(row.disconnect_after_ms / c.decode_step_ms),
                 )
             steps = max(steps, eff - 1)
+        if head_phase:
+            # prompt KV already resident (adopted or locally warm):
+            # only the decode region runs here
+            service_s = (c.batch_overhead_ms + steps * c.decode_step_ms) / 1e3
+            rep.batch = batch
+            self._push(now + service_s, "finish", (i, now))
+            return
         prefill_tokens = 0
         for row in batch:
             toks = row.prompt_len
@@ -410,16 +468,73 @@ class ServingTwin:
         prefill_ms = (
             c.batch_overhead_ms + c.prefill_ms_per_token * prefill_tokens
         )
+        if self.n_prefill and i < self.n_prefill and self._role_up(False):
+            # two-pool path (ISSUE 20): this batch runs ONLY its prefill
+            # region; each finished row's page set then ships to the
+            # decode pool (one handoff_ms per row, the "export" event)
+            rep.batch = batch
+            self._push(now + prefill_ms / 1e3, "export",
+                       (i, now + prefill_ms / 1e3))
+            return
         service_s = (prefill_ms + steps * c.decode_step_ms) / 1e3
         rep.batch = batch
         self._push(now + service_s, "finish", (i, now + prefill_ms / 1e3))
+
+    def _export(self, i: int, first_token_t: float, now: float) -> None:
+        """A prefill replica's batch finished its prefill region: emit
+        the first token (TTFT pins here, like the real `_emit`), release
+        the exporter's pages, and ship each row's page set to the decode
+        pool after `handoff_ms` of transfer time."""
+        rep = self.replicas[i]
+        batch, rep.batch = rep.batch, None
+        for row in batch or ():
+            rep.pages_used -= row.pages
+            row.ttft_ms = (first_token_t - row.arrive_t) * 1e3
+            row.decode_phase = True
+            self.handoffs += 1
+            self._push(now + self.costs.handoff_ms / 1e3, "adopt", row)
+        self._maybe_start(i, now)
+
+    def _adopt(self, row: "_Row", now: float) -> None:
+        """A shipped page set lands: the least-loaded decode replica
+        that can hold it adopts; when none can (down, queue-full, or
+        page-starved — the import shed), the row falls back to the
+        prefill pool for local monolithic decode. Only a fully-dead
+        fleet errors the row."""
+        decode = self._role_up(False)
+        prefill = self._role_up(True)
+        for candidates, fallback in ((decode, False), (prefill, True)):
+            order = sorted(candidates,
+                           key=lambda i: self.replicas[i].depth())
+            for i in order:
+                rep = self.replicas[i]
+                if rep.depth() >= self.cfg.max_queue:
+                    continue
+                if (
+                    self.cfg.kv_pool_pages
+                    and rep.pages_used + row.pages > self.cfg.kv_pool_pages
+                ):
+                    continue
+                if fallback:
+                    self.handoff_fallbacks += 1
+                rep.pages_used += row.pages
+                rep.queue.append(row)
+                self._maybe_start(i, now)
+                return
+        self.counts["error"] += 1
+        self._tstat(row.tenant)["error"] += 1
+        self._tenant_done(row.tenant)
+        self.resolved += 1
 
     def _finish(self, i: int, first_token_t: float, now: float) -> None:
         rep = self.replicas[i]
         batch, rep.batch = rep.batch, None
         for row in batch or ():
             rep.pages_used -= row.pages
-            ttft_ms = (first_token_t - row.arrive_t) * 1e3
+            ttft_ms = (
+                row.ttft_ms if row.ttft_ms is not None
+                else (first_token_t - row.arrive_t) * 1e3
+            )
             if row.disconnect_after_ms is not None:
                 end = first_token_t + row.disconnect_after_ms / 1e3
                 self.counts["disconnected"] += 1
@@ -487,6 +602,11 @@ class ServingTwin:
             if kind == "finish":
                 i, first_t = data
                 self._finish(i, first_t, now)
+            elif kind == "export":
+                i, first_t = data
+                self._export(i, first_t, now)
+            elif kind == "adopt":
+                self._adopt(data, now)
             elif kind == "down":
                 self._down(data, now)
             elif kind == "up":
@@ -515,6 +635,10 @@ class ServingTwin:
             "ttft_ms": {
                 "p50": quantile(ttft, 0.5),
                 "p99": quantile(ttft, 0.99),
+            },
+            "handoff": {
+                "handoffs": self.handoffs,
+                "fallbacks": self.handoff_fallbacks,
             },
             "prefix": {
                 "lookups": self.prefix_lookups,

@@ -155,6 +155,35 @@ class V1TenantSpec(BaseSchema):
         return self
 
 
+class V1PoolsSpec(BaseSchema):
+    """Disaggregated prefill/decode replica pools (ISSUE 20). `prefill`
+    replicas run only chunked-prefill steps and live-hand the finished
+    KV page set to a `decode` replica over POST /kv_import; the router
+    gangs both pools from one ReplicaSetManager and dispatches
+    role-aware. Either pool at zero degrades to monolithic serving."""
+
+    prefill: int | str = 1
+    decode: int | str = 1
+
+    @model_validator(mode="after")
+    def _check(self):
+        for field in ("prefill", "decode"):
+            v = getattr(self, field)
+            if isinstance(v, int) and v < 0:
+                raise ValueError(
+                    f"pools.{field} must be >= 0, got {v}"
+                )
+        if (
+            isinstance(self.prefill, int)
+            and isinstance(self.decode, int)
+            and self.prefill + self.decode < 1
+        ):
+            raise ValueError(
+                "pools needs at least one replica across prefill + decode"
+            )
+        return self
+
+
 class V1ServingSpec(BaseSchema):
     """Serving fast-path knobs (serving/batching.py) a run can pin in its
     spec, so `polyaxon serve --uid <run>` comes up with the shape the model
@@ -245,6 +274,14 @@ class V1ServingSpec(BaseSchema):
     adapters: Optional[dict[str, str]] = None
     tenants: Optional[list[V1TenantSpec]] = None
     adapter_slots: int | str = 0
+    # disaggregated serving (ISSUE 20): `pools` splits the fleet into a
+    # prefill pool (chunked-prefill only; ships the finished page set to
+    # a decode replica as SpillPayload bytes over POST /kv_import) and a
+    # decode pool that adopts the pages and continues the response
+    # mid-flight. Supersedes `replicas` when set. Requires
+    # chunkedPrefill + kvPoolPages + prefixCache (the handoff unit is
+    # the page-aligned prefix-cache chain).
+    pools: Optional[V1PoolsSpec] = None
 
     _MESH_AXES_ALLOWED = ("batch", "model", "data", "fsdp")
 
@@ -402,6 +439,21 @@ class V1ServingSpec(BaseSchema):
                 f"adapterSlots must be >= 0 (0 = one slot per adapter), "
                 f"got {self.adapter_slots}"
             )
+        if self.pools is not None:
+            has_prefill = not (
+                isinstance(self.pools.prefill, int) and self.pools.prefill == 0
+            )
+            if has_prefill and (
+                not self.chunked_prefill
+                or self.kv_pool_pages is None
+                or not self.prefix_cache
+            ):
+                raise ValueError(
+                    "pools with a prefill pool requires chunkedPrefill + "
+                    "kvPoolPages + prefixCache: the handoff ships the "
+                    "page-aligned prefix-cache chain a chunked prefill "
+                    "leaves behind"
+                )
         return self
 
     def to_config(self):

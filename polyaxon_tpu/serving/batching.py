@@ -256,6 +256,16 @@ class ServingConfig:
     adapters: tuple = ()
     tenants: tuple = ()
     adapter_slots: int = 0
+    # disaggregated pools (ISSUE 20): role splits serving across replica
+    # pools. "both" (default) keeps the monolithic server; "prefill"
+    # runs only chunked-prefill steps and ships the finished page set to
+    # a decode replica over POST /kv_import (falling back to local
+    # monolithic decode when no decode replica is routable or the import
+    # sheds); "decode" advertises itself as an adoption target. The role
+    # is pure dispatch advertisement — a decode replica still serves
+    # whole requests, which is what makes prefill-pool outage degrade
+    # gracefully instead of failing.
+    role: str = "both"
 
     def ladders(self, seq_len: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
         pl = self.prompt_buckets or bucket_ladder(min(32, seq_len), seq_len)
@@ -363,6 +373,14 @@ class PendingRequest:
     tenant: str = "default"
     adapter: str = ""  # adapter name, for registry release on finish
     adapter_slot: int = 0
+    # disaggregated handoff (ISSUE 20): on a prefill-role server the
+    # router names a decode replica in X-Handoff-Target; after the final
+    # prefill slice the step engine exports the finished page set, parks
+    # the wire bytes here, and resolves the row with a sentinel error so
+    # the HTTP handler thread (not the decode worker) runs the transfer
+    handoff_target: Optional[str] = None
+    handoff_epoch: int = 0
+    handoff_payload: Optional[bytes] = None
 
     def cancel(self) -> None:
         """Mark the row as abandoned by its client. Safe from any thread;

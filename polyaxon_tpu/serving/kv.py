@@ -161,6 +161,14 @@ class KVCacheManager:
         self.spill_restores = 0
         self.restore_skipped = 0
         self.restore_aborted = 0
+        # ---- live KV handoff (ISSUE 20) -----------------------------------
+        # pages held by adopt-queued restores not yet flushed to the
+        # device: in-transit handoff pages that must read as HELD, not
+        # leaked, in drain/leak accounting (mirroring prefix_held)
+        self._handoff_pending = 0
+        self.handoff_exports = 0
+        self.handoff_adopted_pages = 0
+        self.handoff_adopt_aborted = 0
         self.spill_skipped = 0  # demotes with missing mirror bytes
         self.mirror_capture_failures = 0
         # 0, not the post-heal value: startup quarantines surface on the
@@ -184,6 +192,7 @@ class KVCacheManager:
             prefix_held=(
                 self.prefix.held_pages if self.prefix is not None else 0
             ),
+            handoff_held=self._handoff_pending,
         )
 
     @property
@@ -598,8 +607,7 @@ class KVCacheManager:
             if inserted == 0:
                 # lost the admission race (hash slot taken by different
                 # content): cancel the queued device write, free its pages
-                self._pending_restores.remove(queued)
-                self.pool.unref(queued[0])
+                self._unqueue_restore(queued)
                 queued = None
                 self.restore_aborted += 1
             else:
@@ -609,19 +617,16 @@ class KVCacheManager:
             self._pages_changed()
         except BaseException:
             if queued is not None:
-                try:
-                    self._pending_restores.remove(queued)
-                except ValueError:
-                    pass
-                else:
-                    self.pool.unref(queued[0])
+                self._unqueue_restore(queued)
             self.pool.unref(new_ids)
             raise
 
-    def _queue_restore(self, new_ids, pages_payload) -> tuple:
+    def _queue_restore(self, new_ids, pages_payload, tag: str = "spill") -> tuple:
         """Queue the device write for restored pages. The item holds its
         OWN pool refs, so an eviction racing the flush is harmless — the
-        write lands in still-held pages, which free right after."""
+        write lands in still-held pages, which free right after.
+        `tag="handoff"` items additionally count into `_handoff_pending`
+        (the in-transit page gauge) until flushed."""
         import numpy as np
 
         scanned = bool(getattr(self.module.cfg, "scan_layers", False))
@@ -634,9 +639,23 @@ class KVCacheManager:
             for l in range(n_leaves)
         ]
         self.pool.ref(new_ids)
-        item = (list(new_ids), vals)
+        item = (list(new_ids), vals, tag)
         self._pending_restores.append(item)
+        if tag == "handoff":
+            self._handoff_pending += len(new_ids)
         return item
+
+    def _unqueue_restore(self, item) -> bool:
+        """Cancel one queued restore (abort path): drop it from the
+        pending list and return its refs. Caller holds self._lock."""
+        try:
+            self._pending_restores.remove(item)
+        except ValueError:
+            return False
+        self.pool.unref(item[0])
+        if item[2] == "handoff":
+            self._handoff_pending -= len(item[0])
+        return True
 
     def _restore_fn(self, n_new: int):
         """Compiled scatter of `n_new` restored pages into the pool
@@ -673,7 +692,7 @@ class KVCacheManager:
         import numpy as np
 
         done = 0
-        for ids, vals in pending:
+        for ids, vals, tag in pending:
             fn = self._restore_fn(len(ids))
             self.cache = fn(
                 self.cache,
@@ -683,8 +702,135 @@ class KVCacheManager:
             done += 1
             with self._lock:
                 self.pool.unref(ids)
+                if tag == "handoff":
+                    self._handoff_pending -= len(ids)
                 self._pages_changed()
         return done
+
+    # ------------------------------------------------- live handoff (ISSUE 20)
+    def export_prefix(self, tokens) -> Optional[SpillPayload]:
+        """Capture the longest cached page-aligned prefix of `tokens` as
+        a host SpillPayload — the wire unit of the live KV handoff.
+
+        WORKER THREAD ONLY, right after the producing program returned
+        (same contract as `_capture_mirror`): that is the one moment the
+        pool bytes are guaranteed readable before a later donated
+        program invalidates them. The chain pages are ref-held across
+        the device read so a racing eviction cannot recycle them
+        mid-capture. Returns None when nothing page-aligned is cached
+        (prompt shorter than a page, prefix cache off) — the caller
+        falls back to monolithic decode."""
+        if self.prefix is None:
+            return None
+        pt = self.layout.page_tokens
+        k = len(tokens) // pt
+        if k < 1:
+            return None
+        with self._lock:
+            _plen, page_ids = self.prefix.peek(tokens, max_tokens=k * pt)
+            j = len(page_ids)
+            if j < 1:
+                return None
+            page_ids = list(page_ids)
+            self.pool.ref(page_ids)
+        try:
+            pages = self._capture_mirror(page_ids)
+        finally:
+            with self._lock:
+                self.pool.unref(page_ids)
+                self._pages_changed()
+        hashes = page_hashes(tokens[: j * pt], pt, self.prefix.hash_fn)
+        with self._lock:
+            self.handoff_exports += 1
+        return SpillPayload(
+            tuple(int(t) for t in tokens[: j * pt]), tuple(hashes), pages
+        )
+
+    def adopt_pages(self, payload: SpillPayload) -> int:
+        """Adopt an imported handoff page set: allocate pool pages,
+        queue the device write (flushed by the worker before the next
+        prefill, exactly like a spill restore), and index every chain
+        link in the prefix cache so the failed-over request's admission
+        hits it. Content verification (CRC frames + hash chain vs the
+        prompt tokens) is the HTTP layer's job — this method owns the
+        refcount/reservation invariants only.
+
+        Returns the number of newly adopted pages (0 when the chain is
+        already resident — a repeated import is idempotent). Raises
+        ShedError(reason="kv_handoff") when there is no headroom even
+        after LRU eviction: cache warmth never eats admission headroom,
+        and the exporter's fallback path is cheaper than an OOM here.
+        Every abort path — chaos raise, collision race, headroom shed —
+        returns every page this adoption holds (zero-leak)."""
+        if self.prefix is None:
+            raise ServingError("kv handoff requires the prefix cache")
+        pt = self.layout.page_tokens
+        tokens = tuple(int(t) for t in payload.tokens)
+        j = len(payload.pages)
+        with self._lock:
+            _plen, k_pages = self.prefix.peek(tokens, max_tokens=len(tokens))
+            k = len(k_pages)
+            n_new = j - k
+            if n_new <= 0:
+                return 0
+            if self.pool.available < n_new:
+                if not self.prefix.evict_for(n_new):
+                    self._observe("shed", reason="kv_handoff")
+                    raise ShedError(
+                        f"KV pool cannot adopt {n_new} handoff pages "
+                        f"({self.pool.available} free)",
+                        reason="kv_handoff",
+                    )
+                self._observe("prefix_evict")
+            try:
+                new_ids = self.pool.alloc(n_new)
+            except PagePoolExhausted as e:
+                self._observe("shed", reason="kv_handoff")
+                raise ShedError(
+                    f"KV pool cannot adopt handoff pages: {e}",
+                    reason="kv_handoff",
+                ) from None
+            queued = None
+            try:
+                # chaos: a kill here is a death mid-adopt — the except
+                # arm must return every page this adoption holds
+                inject("serving.kv_adopt", h=payload.hashes[-1], pages=n_new)
+                queued = self._queue_restore(
+                    new_ids, payload.pages[k:], tag="handoff"
+                )
+                if self._spill is not None:
+                    for pos in range(1, j + 1):
+                        self._mirror.setdefault(
+                            payload.hashes[pos - 1], payload.pages[pos - 1]
+                        )
+                inserted = 0
+                for jj in range(k + 1, j + 1):
+                    pages_jj = tuple(k_pages) + tuple(new_ids[: jj - k])
+                    if self.prefix.insert(tokens[: jj * pt], pages_jj):
+                        inserted += 1
+                        if self._spill is not None:
+                            self._mirror_ref(payload.hashes[:jj])
+                if self._spill is not None:
+                    self._mirror_gc(payload.hashes)
+                if inserted == 0:
+                    # collision race: different content owns the chain
+                    # slots — cancel the queued write, free the pages
+                    self._unqueue_restore(queued)
+                    queued = None
+                    self.handoff_adopt_aborted += 1
+                    n_new = 0
+                else:
+                    self.handoff_adopted_pages += n_new
+                    self._observe("kv_handoff_adopt", pages=n_new)
+                self.pool.unref(new_ids)
+                self._pages_changed()
+                return n_new
+            except BaseException:
+                if queued is not None:
+                    self._unqueue_restore(queued)
+                self.pool.unref(new_ids)
+                self._pages_changed()
+                raise
 
     def advertised_heads(self) -> list[str]:
         """Chain hashes restorable on this replica — resident PrefixCache
@@ -729,6 +875,18 @@ class KVCacheManager:
                     "misses": self.prefix.misses,
                     "evictions": self.prefix.evictions,
                     "collisions": self.prefix.collisions,
+                }
+            if (
+                self.handoff_exports
+                or self.handoff_adopted_pages
+                or self.handoff_adopt_aborted
+                or self._handoff_pending
+            ):
+                out["handoff"] = {
+                    "exports": self.handoff_exports,
+                    "adopted_pages": self.handoff_adopted_pages,
+                    "adopt_aborted": self.handoff_adopt_aborted,
+                    "pending_pages": self._handoff_pending,
                 }
             if self._spill is not None:
                 out["spill"] = {

@@ -140,6 +140,10 @@ class ReplicaState:
     # paged KV / prefix cache disabled / scrape failed)
     kv_page_tokens: int = 0
     kv_heads: int = 0  # advertised prefix head count (stats surface)
+    # disaggregated pools (ISSUE 20): the role the replica advertises on
+    # /readyz — "prefill" replicas get a decode sibling named in
+    # X-Handoff-Target; "both" (monolithic) is the safe default
+    role: str = "both"
     # last scraped cumulative queue-wait sums, for the delta
     _wait_sum: float = 0.0
     _wait_count: float = 0.0
@@ -383,21 +387,28 @@ class Router:
                     s.draining = draining
 
     def _probe(self, s: ReplicaState) -> None:
+        role = s.role
         try:
             with urlrequest.urlopen(
                 s.url + "/readyz", timeout=self.probe_timeout_s
             ) as r:
-                ready = json.loads(r.read()).get("ready", False)
+                adv = json.loads(r.read())
+                ready = adv.get("ready", False)
+                role = str(adv.get("role") or "both")
         except urlerror.HTTPError as e:
-            # /readyz answers 503 with the same body while draining
+            # /readyz answers 503 with the same body while draining —
+            # including the role, so a draining replica keeps its pool
             try:
-                ready = bool(json.loads(e.read()).get("ready", False))
+                adv = json.loads(e.read())
+                ready = bool(adv.get("ready", False))
+                role = str(adv.get("role") or "both")
             except Exception:
                 ready = False
         except Exception:
             s.healthy = False
             return
         s.healthy = bool(ready)
+        s.role = role
         try:
             with urlrequest.urlopen(
                 s.url + "/metricsz", timeout=self.probe_timeout_s
@@ -569,6 +580,18 @@ class Router:
         zero-parse happy path."""
         candidates = self._candidates()
         order = self.balancer.order(candidates)
+        roles = {s.role for s in order}
+        if "prefill" in roles and len(roles) > 1:
+            # disaggregated pools (ISSUE 20): a fresh prompt starts on
+            # the best prefill replica; decode-capable siblings follow —
+            # exactly where the post-handoff retry (the 503 with reason
+            # kv_handoff_done, or the in-band stream error frame) lands.
+            # A prefill-only fleet keeps plain JSQ order and decodes
+            # monolithically; affinity below may still promote a warm
+            # holder to the front.
+            pre = [s for s in order if s.role == "prefill"]
+            rest = [s for s in order if s.role != "prefill"]
+            order = [pre[0], *rest, *pre[1:]]
         if (
             not self.affinity_enabled
             or len(order) < 2  # nothing to choose between
@@ -638,7 +661,8 @@ class Router:
                 self._m_retries.inc()
             t_att = _now()
             status, payload, headers = self._forward_once(
-                s, body, rid, query, tenant
+                s, body, rid, query, tenant,
+                handoff=self._handoff_for(s, order, i),
             )
             retryable = self._retryable(status, payload)
             if trace is not None:
@@ -671,9 +695,27 @@ class Router:
             return reason not in _NO_RETRY_REASONS
         return False
 
+    def _handoff_for(
+        self, s: ReplicaState, order: list[ReplicaState], attempt: int
+    ) -> Optional[tuple[str, int]]:
+        """(decode target URL, epoch) for a forward to `s`, or None.
+        Only a prefill replica gets a target, and only when a
+        decode-capable sibling is in the candidate order — otherwise the
+        header is omitted and the prefill replica degrades to monolithic
+        decode locally. The epoch is the router attempt index: a
+        failed-over request's later exporter always outranks the stale
+        one at the decode side's lease table."""
+        if s.role != "prefill":
+            return None
+        sinks = [c for c in order if c is not s and c.role != "prefill"]
+        if not sinks:
+            return None
+        return sinks[0].url, attempt
+
     def _forward_once(
         self, s: ReplicaState, body: bytes, rid: str, query: str,
         tenant: str = "",
+        handoff: Optional[tuple[str, int]] = None,
     ) -> tuple[int, bytes, dict]:
         url = s.url + "/generate" + (f"?{query}" if query else "")
         headers = {
@@ -685,6 +727,9 @@ class Router:
         # into admission exactly as on a direct request
         if tenant:
             headers["X-Tenant"] = tenant
+        if handoff is not None:
+            headers["X-Handoff-Target"] = handoff[0]
+            headers["X-Handoff-Epoch"] = str(handoff[1])
         req = urlrequest.Request(
             url,
             data=body,
@@ -772,7 +817,8 @@ class Router:
             t_att = _now()
             try:
                 gen = self._stream_once(
-                    s, body, rid, query, sent, done_rows, tenant
+                    s, body, rid, query, sent, done_rows, tenant,
+                    handoff=self._handoff_for(s, order, i),
                 )
                 for frame in gen:
                     started = True
@@ -826,6 +872,7 @@ class Router:
         sent: dict[int, int],
         done_rows: set[int],
         tenant: str = "",
+        handoff: Optional[tuple[str, int]] = None,
     ):
         q = query or "stream=1"
         if "stream=1" not in q.split("&"):
@@ -836,6 +883,9 @@ class Router:
         }
         if tenant:
             headers["X-Tenant"] = tenant
+        if handoff is not None:
+            headers["X-Handoff-Target"] = handoff[0]
+            headers["X-Handoff-Epoch"] = str(handoff[1])
         req = urlrequest.Request(
             s.url + "/generate?" + q,
             data=body,
@@ -1081,6 +1131,7 @@ class Router:
                 "requests": s.requests,
                 "weight": s.weight,
                 "prefix_heads": s.kv_heads,
+                "replica_role": s.role,
             }
             for s in self.states()
         ]

@@ -135,6 +135,18 @@ def _error_reason(error: BaseException) -> str:
     return "internal"
 
 
+class _HandoffPrefillDone(Exception):
+    """Sentinel resolving a prefill-role row (ISSUE 20): the first token
+    is out and the finished page set is exported, but the transfer has
+    NOT run — the HTTP handler thread must ship it (network I/O never
+    rides the decode worker). Callers convert this into either a
+    retryable failover (shipped) or a local monolithic re-run (not)."""
+
+    def __init__(self, first_token: int):
+        super().__init__("prefill complete: KV handoff pending")
+        self.first_token = int(first_token)
+
+
 def _restore_params_subtree(ckpt_dir: str, abstract_params):
     """Read ONLY the params subtree of a saved TrainState (Orbax partial
     restore) into the shardings carried by `abstract_params`.
@@ -224,6 +236,24 @@ class ModelServer:
             raise ValueError(
                 "spill_ram_bytes/spill_dir require the paged KV pool with "
                 "the prefix cache (set kv_pool_pages, keep prefix_cache on)"
+            )
+        # disaggregated pools (ISSUE 20): the handoff unit is the
+        # page-aligned prefix-cache chain a chunked prefill leaves
+        # behind, so a prefill-role replica needs all three ingredients
+        if self.config.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', "
+                f"got {self.config.role!r}"
+            )
+        if self.config.role == "prefill" and not (
+            self.config.chunked_prefill
+            and self.config.kv_pool_pages
+            and self.config.prefix_cache
+        ):
+            raise ValueError(
+                "role='prefill' requires chunked_prefill + kv_pool_pages "
+                "+ prefix_cache (the handoff ships the page-aligned "
+                "prefix chain chunked prefill leaves in the cache)"
             )
         # int8 quantize-on-load (ISSUE 8): rebuild the module with the
         # Int8Dense projection path and transform the restored fp params
@@ -463,6 +493,47 @@ class ModelServer:
             "serving.kv_spill_quarantined",
             help="Corrupt spill segments quarantined to <seg>.corrupt and "
             "served as clean misses",
+        )
+        # live KV handoff series (ISSUE 20) — registered from startup
+        # (zeros when pools are off) so the canary's handoff gate can
+        # scrape them unconditionally
+        self._m_handoff_ms = self.telemetry.histogram(
+            "serving.kv_handoff_ms",
+            buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000),
+            help="Prefill→decode KV handoff wall time, milliseconds "
+            "(payload capture through import acknowledgement)",
+        )
+        self._m_handoff_exports = self.telemetry.counter(
+            "serving.kv_handoff_exports",
+            help="Page sets this replica exported to a decode replica "
+            "over POST /kv_import (acknowledged adoptions)",
+        )
+        self._m_handoff_imports = self.telemetry.counter(
+            "serving.kv_handoff_imports",
+            help="Page sets this replica adopted from a prefill replica "
+            "via POST /kv_import",
+        )
+        self._m_handoff_rejected = self.telemetry.counter(
+            "serving.kv_handoff_rejected",
+            help="Imports refused: stale lease epoch (409), CRC/hash "
+            "verification failure (400), or headroom shed (503)",
+        )
+        self._m_handoff_fallbacks = self.telemetry.counter(
+            "serving.kv_handoff_fallbacks",
+            help="Prefill-role requests that completed by LOCAL "
+            "monolithic decode because no decode replica could adopt "
+            "(no target routable, import shed, retries exhausted)",
+        )
+        self._m_handoff_inflight = self.telemetry.gauge(
+            "serving.kv_handoff_inflight",
+            help="Handoff exports in flight (captured, not yet "
+            "acknowledged or fallen back) — drain waits on zero",
+        )
+        self._m_kv_handoff_held = self.telemetry.gauge(
+            "serving.kv_pages_handoff_held",
+            help="KV pages held by adopted-but-not-yet-flushed handoff "
+            "imports — in-transit state, not a leak; mirrors "
+            "kv_pages_prefix_held in drain accounting",
         )
         # fast-decode series (ISSUE 8) — registered from startup (zeros
         # when speculation/quant are off) so the canary's spec gate can
@@ -734,6 +805,124 @@ class ModelServer:
             )
             self._m_kv_total.set(self._kv.pool.n_pages)
             self._m_kv_used.set(self._kv.pool.used)
+        # live KV handoff state (ISSUE 20). The lease table guards the
+        # decode side (single-owner adoption per request id, monotonic
+        # epochs); the client ships exports from the prefill side with
+        # RetryPolicy-driven retries. Exports-in-flight gates drain: a
+        # replica must not report idle while a page set is on the wire.
+        from .handoff import HandoffClient, LeaseTable
+
+        self._lease_table = LeaseTable()
+        self._handoff_client = HandoffClient()
+        self._handoff_lock = threading.Lock()
+        self._handoff_inflight = 0
+        self._handoff_idle = threading.Event()
+        self._handoff_idle.set()
+
+    def _handoff_begin(self) -> None:
+        with self._handoff_lock:
+            self._handoff_inflight += 1
+            self._handoff_idle.clear()
+            self._m_handoff_inflight.set(self._handoff_inflight)
+
+    def _handoff_end(self) -> None:
+        with self._handoff_lock:
+            self._handoff_inflight -= 1
+            self._m_handoff_inflight.set(self._handoff_inflight)
+            if self._handoff_inflight <= 0:
+                self._handoff_idle.set()
+
+    def _handoff_ship(self, r: PendingRequest) -> bool:
+        """POST the exported page set to the router-named decode replica.
+        Handler-thread only. True → the decode side adopted the pages
+        (the caller converts the row into a retryable failover so the
+        router replays on that replica); False → the caller falls back
+        to local monolithic decode. Never raises: every transport and
+        protocol failure is a structured HandoffResult reason."""
+        if not r.handoff_payload or not r.handoff_target:
+            return False
+        t0 = _now()
+        self._handoff_begin()
+        try:
+            res = self._handoff_client.send(
+                r.handoff_target,
+                r.request_id or new_trace_id(),
+                r.handoff_payload,
+                base_epoch=int(r.handoff_epoch),
+            )
+        finally:
+            self._handoff_end()
+            self._m_handoff_ms.observe((_now() - t0) * 1e3)
+        if res.ok:
+            self._m_handoff_exports.inc()
+            if r.trace is not None:
+                r.trace.add(
+                    "kv_handoff", start=t0, dur_s=_now() - t0, row=r.row,
+                    pages=res.adopted_pages, epoch=res.epoch,
+                    attempts=res.attempts,
+                )
+            return True
+        self._m_handoff_rejected.inc()
+        self._observe(
+            "kv_handoff_failed", reason=res.reason, attempts=res.attempts,
+        )
+        return False
+
+    def _handoff_rerun(self, req: dict, row_idx: int) -> PendingRequest:
+        """Monolithic fallback after a failed handoff: re-run one row of
+        the validated request locally, with the handoff target cleared.
+        The finished prefix is already warm in this replica's cache, so
+        the re-run skips straight to decode. Returns the resolved row;
+        raises its error (shed/timeout) for the HTTP taxonomy."""
+        self._m_handoff_fallbacks.inc()
+        sub = dict(req)
+        sub["arr"] = req["arr"][row_idx : row_idx + 1]
+        # _make_requests seeds row i as seed+i; keep the original row's
+        # stream so the fallback stays byte-identical to a monolithic run
+        sub["seed"] = int(req["seed"]) + row_idx
+        sub["handoff_target"] = ""
+        rows = self._make_requests(sub)
+        r2 = rows[0]
+        r2.row = row_idx
+        r2.submitted_t = _now()
+        try:
+            self._coalescer.submit(r2)
+        except BaseException:
+            self._release_row(r2)
+            raise
+        if not r2.done.wait(self.config.request_timeout_s):
+            raise TimeoutError(
+                f"handoff fallback did not complete within "
+                f"{self.config.request_timeout_s:.0f}s"
+            )
+        if r2.error is not None:
+            raise r2.error
+        return r2
+
+    def _handoff_stream_resolve(self, req: dict, r: PendingRequest) -> list:
+        """Terminal events for a streamed row whose prefill finished with
+        a pending handoff. Shipped → one in-band error frame the
+        router's failover machinery treats as retryable (it replays the
+        stream on the decode replica and trims the already-sent first
+        token). Not shipped → local monolithic fallback: the remaining
+        tokens stream as one chunk (the first is already on the wire),
+        then done."""
+        i = r.row
+        if self._handoff_ship(r):
+            return [{
+                "row": i,
+                "error": "kv_handoff_done: decode replica owns the stream",
+            }]
+        try:
+            r2 = self._handoff_rerun(req, i)
+        except BaseException as e:  # noqa: BLE001 — in-band taxonomy
+            return [{"row": i, "error": str(e)}]
+        out = []
+        rest = r2.result[r2.prompt_len + 1 :]
+        if rest:
+            out.append({"row": i, "tokens": [int(t) for t in rest]})
+        out.append({"row": i, "done": True})
+        return out
 
     def _make_coalescer(self) -> DecodeCoalescer:
         breaker = CircuitBreaker(
@@ -816,6 +1005,9 @@ class ModelServer:
         if event == "kv_pages":
             self._m_kv_used.set(ctx["used"])
             self._m_kv_prefix_held.set(ctx.get("prefix_held", 0))
+            self._m_kv_handoff_held.set(ctx.get("handoff_held", 0))
+        elif event == "kv_handoff_adopt":
+            self._m_handoff_imports.inc()
         elif event == "prefix_hit":
             self._m_prefix_hits.inc()
         elif event == "prefix_miss":
@@ -1361,6 +1553,17 @@ class ModelServer:
                 "adapter-bound tenants require the coalesced decode path "
                 "(no beam search, batching enabled)"
             )
+        # disaggregated handoff (ISSUE 20): the router names a decode
+        # replica in X-Handoff-Target (do_POST copies the header into
+        # the body, same pattern as X-Tenant). Only a prefill-role
+        # server acts on it; everyone else decodes monolithically.
+        handoff_target, handoff_epoch = "", 0
+        if self.config.role == "prefill":
+            handoff_target = str(body.get("handoffTarget") or "").strip()
+            try:
+                handoff_epoch = int(body.get("handoffEpoch") or 0)
+            except (TypeError, ValueError):
+                handoff_epoch = 0
         return {
             "tenant": tenant,
             "adapter": adapter,
@@ -1374,6 +1577,8 @@ class ModelServer:
             "num_beams": num_beams,
             "length_penalty": float(body.get("lengthPenalty", 1.0)),
             "seed": int(body.get("seed", 0)),
+            "handoff_target": handoff_target,
+            "handoff_epoch": handoff_epoch,
         }
 
     def _make_requests(self, req: dict) -> list[PendingRequest]:
@@ -1483,6 +1688,8 @@ class ModelServer:
                     tenant=tenant,
                     adapter=adapter,
                     adapter_slot=slot,
+                    handoff_target=req.get("handoff_target") or None,
+                    handoff_epoch=int(req.get("handoff_epoch") or 0),
                 )
                 if plan is not None or adapter:
                     # on ANY terminal path (scatter, shed, deadline, crash,
@@ -2440,6 +2647,29 @@ class ModelServer:
                 raise TimeoutError(
                     f"decode did not complete within {timeout:.0f}s"
                 )
+        # disaggregated handoff (ISSUE 20): prefill-role rows resolve
+        # with a sentinel — page set exported, transfer not yet run.
+        # Ship on this handler thread. Every ship landed → retryable 503
+        # (reason kv_handoff_done): the router replays the body on the
+        # decode replica, which adopts the pages and continues. Any ship
+        # failed → monolithic fallback: re-run those rows locally (the
+        # prefix is warm here; the decode side's partial adoptions are
+        # just evictable cache warmth, never a leak).
+        pending_handoff = [
+            r for r in rows if isinstance(r.error, _HandoffPrefillDone)
+        ]
+        if pending_handoff:
+            shipped = [self._handoff_ship(r) for r in pending_handoff]
+            if all(shipped):
+                self._observe("shed", reason="kv_handoff_done")
+                raise ShedError(
+                    "prefill complete: decode replica owns the KV",
+                    reason="kv_handoff_done",
+                )
+            for r in pending_handoff:
+                r2 = self._handoff_rerun(req, r.row)
+                r.result, r.error = r2.result, None
+        for r in rows:
             if r.error is not None:
                 raise r.error
         out = {"tokens": [r.result for r in rows]}
@@ -2555,9 +2785,21 @@ class ModelServer:
                         f"decode did not complete within "
                         f"{self.config.request_timeout_s:.0f}s"
                     ) from None
-                if "done" in ev or "error" in ev:
-                    pending -= 1
-                yield ev
+                evs = [ev]
+                if "error" in ev and isinstance(
+                    rows[ev["row"]].error, _HandoffPrefillDone
+                ):
+                    # disaggregated handoff (ISSUE 20): ship the
+                    # exported page set now; shipped → in-band error
+                    # frame (router replays on the decode replica with
+                    # trim), failed → local fallback events instead
+                    evs = self._handoff_stream_resolve(
+                        req, rows[ev["row"]]
+                    )
+                for ev in evs:
+                    if "done" in ev or "error" in ev:
+                        pending -= 1
+                    yield ev
             if trace is not None:
                 done_t = max(
                     (r.finished_t for r in rows if r.finished_t is not None),
@@ -2629,11 +2871,17 @@ class ModelServer:
         (in-pool or spilled), keyed by the pool's page size so the router
         hashes request prompts the same way."""
         if self._kv is None:
-            return {"enabled": False, "pageTokens": 0, "heads": []}
+            return {
+                "enabled": False,
+                "pageTokens": 0,
+                "heads": [],
+                "role": self.config.role,
+            }
         return {
             "enabled": self._kv.prefix is not None,
             "pageTokens": self._kv.layout.page_tokens,
             "heads": self._kv.advertised_heads(),
+            "role": self.config.role,
         }
 
     def stats(self) -> dict:
@@ -2754,8 +3002,22 @@ class ModelServer:
             tenancy["adapters"] = self._adapter_registry.stats()
             if self._adapter_spill is not None:
                 tenancy["adapter_spill"] = self._adapter_spill.stats()
+        # disaggregated handoff (ISSUE 20): in-transit exports count as
+        # held work (they gate drain), never as leaked pages — adopted
+        # and harvested pages are prefix-cache entries, already covered
+        # by the prefix_held discount in the kv block above
+        handoff = {
+            "role": self.config.role,
+            "inflight": int(self._handoff_inflight),
+            "exports": int(self._m_handoff_exports.value),
+            "imports": int(self._m_handoff_imports.value),
+            "rejected": int(self._m_handoff_rejected.value),
+            "fallbacks": int(self._m_handoff_fallbacks.value),
+            "leases": self._lease_table.stats(),
+        }
         return {
             "tenancy": tenancy,
+            "handoff": handoff,
             "mesh": mesh,
             "kv": kv,
             "chunked": chunked,
@@ -2839,9 +3101,17 @@ class ModelServer:
                     )
                 elif path == "/readyz":
                     ready, reason = server.readiness()
+                    # role rides readiness (ISSUE 20): the router learns
+                    # pool membership from the same probe it already
+                    # makes — on BOTH the 200 and the 503 body, so a
+                    # draining prefill replica still advertises its pool
                     self._send(
                         200 if ready else 503,
-                        {"ready": ready, "reason": reason},
+                        {
+                            "ready": ready,
+                            "reason": reason,
+                            "role": server.config.role,
+                        },
                     )
                 elif path == "/statsz":
                     self._send(200, server.stats())
@@ -2884,6 +3154,127 @@ class ModelServer:
                     self._send(code, payload)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
+
+            def _kv_import(self):
+                """POST /kv_import (ISSUE 20): adopt a prefill replica's
+                exported page set. Status taxonomy the exporter's
+                HandoffClient keys on: 400 malformed bytes or hash-chain
+                mismatch (final — identical bytes never do better), 409
+                stale epoch (a newer owner exists: stand down), 503 shed
+                with reason kv_handoff (pool full, nothing evictable),
+                200 with the adopted page count. Every abort path
+                releases the lease so a higher-epoch retry proceeds."""
+                from .handoff import (
+                    HandoffError,
+                    StaleLeaseError,
+                    payload_from_wire,
+                )
+                from ..models.kv_pages import page_hashes
+
+                rid = (
+                    self.headers.get("X-Handoff-Id") or ""
+                ).strip()[:128] or None
+                server._m_http.inc()
+                kv = server._kv
+                if kv is None or kv.prefix is None:
+                    server._m_handoff_rejected.inc()
+                    self._send(
+                        400,
+                        {
+                            "error": "no prefix cache on this replica",
+                            "reason": "rejected",
+                        },
+                        rid=rid,
+                    )
+                    return
+                try:
+                    epoch = int(self.headers.get("X-Handoff-Epoch") or 0)
+                except ValueError:
+                    epoch = 0
+                lease = None
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    data = self.rfile.read(n)
+                    # chaos: a fault in the import window must adopt
+                    # fully or not at all, and the exporter must see a
+                    # clean failure it can retry or fall back from
+                    inject(
+                        "serving.kv_import",
+                        rid=rid, epoch=epoch, size=len(data),
+                    )
+                    payload = payload_from_wire(data)
+                    want = page_hashes(
+                        list(payload.tokens),
+                        kv.layout.page_tokens,
+                        kv.prefix.hash_fn,
+                    )
+                    if list(want) != list(payload.hashes):
+                        raise HandoffError(
+                            "content-hash chain does not match the "
+                            "prompt tokens"
+                        )
+                    lease = server._lease_table.acquire(
+                        rid or "anon", epoch
+                    )
+                    adopted = kv.adopt_pages(payload)
+                    if server._lease_table.complete(lease):
+                        self._send(
+                            200, {"adopted_pages": int(adopted)}, rid=rid
+                        )
+                    else:
+                        # preempted mid-adopt by a higher epoch: the
+                        # newer owner's adoption is authoritative; ours
+                        # is just evictable cache warmth. Tell this
+                        # exporter to stand down.
+                        server._m_handoff_rejected.inc()
+                        self._send(
+                            409,
+                            {
+                                "error": "preempted mid-adopt",
+                                "reason": "stale_epoch",
+                            },
+                            rid=rid,
+                        )
+                except StaleLeaseError as e:
+                    server._m_handoff_rejected.inc()
+                    self._send(
+                        409,
+                        {"error": str(e), "reason": "stale_epoch"},
+                        rid=rid,
+                    )
+                except HandoffError as e:
+                    server._m_handoff_rejected.inc()
+                    self._send(
+                        400,
+                        {"error": str(e), "reason": "rejected"},
+                        rid=rid,
+                    )
+                except ShedError as e:
+                    if lease is not None:
+                        server._lease_table.release(lease)
+                    server._m_http_err.inc()
+                    self._send(
+                        503,
+                        {"error": str(e), "reason": e.reason},
+                        headers={
+                            "Retry-After": str(
+                                max(1, int(round(e.retry_after_s)))
+                            )
+                        },
+                        rid=rid,
+                    )
+                except Exception as e:  # noqa: BLE001 — surface, don't kill
+                    if lease is not None:
+                        server._lease_table.release(lease)
+                    server._m_http_err.inc()
+                    self._send(
+                        500,
+                        {
+                            "error": f"{type(e).__name__}: {e}",
+                            "reason": "internal",
+                        },
+                        rid=rid,
+                    )
 
             def _tracez(self, query: str):
                 # ONE /tracez contract across every surface that owns a
@@ -2938,6 +3329,9 @@ class ModelServer:
 
             def do_POST(self):
                 path, _, query = self.path.partition("?")
+                if path == "/kv_import":
+                    self._kv_import()
+                    return
                 if path != "/generate":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
@@ -2960,6 +3354,18 @@ class ModelServer:
                     ).strip()[:128]
                     if hdr_tenant and isinstance(body, dict):
                         body.setdefault("tenant", hdr_tenant)
+                    # X-Handoff-Target/-Epoch (ISSUE 20): the router
+                    # names the decode replica the same way — header →
+                    # body field, body wins when both are present
+                    hdr_target = (
+                        self.headers.get("X-Handoff-Target") or ""
+                    ).strip()[:256]
+                    if hdr_target and isinstance(body, dict):
+                        body.setdefault("handoffTarget", hdr_target)
+                        body.setdefault(
+                            "handoffEpoch",
+                            self.headers.get("X-Handoff-Epoch") or 0,
+                        )
                     if want_stream and server.config.stream:
                         self._stream(body, rid)
                     else:
@@ -3045,6 +3451,10 @@ class ModelServer:
         )
         self._draining = True
         self._m_ready.set(0)
+        # drain honesty (ISSUE 20): an export in flight holds pages the
+        # leak accounting cannot see yet — a drain must not report idle
+        # while a page set is on the wire. Bounded by the same grace.
+        self._handoff_idle.wait(timeout=max(0.0, grace))
         if self.slo_engine is not None:
             self.slo_engine.stop()
         if self.sentinel is not None:
@@ -3225,7 +3635,7 @@ class _StepEngine:
             self._finish_row(r)
         elif r.max_new <= 1:
             self._finish_row(r)
-        else:
+        elif not self._maybe_handoff(r, first_i):
             st.tok = first_i
             st.done = False
             st.pos = st.L + st.pb
@@ -3255,6 +3665,51 @@ class _StepEngine:
                 st.remaining = r.max_new - 1
             st.phase = "decode"
         return width
+
+    def _maybe_handoff(self, r: PendingRequest, first_i: int) -> bool:
+        """Prefill-role exit (ISSUE 20). With a decode target named by
+        the router, harvest the finished page set into the prefix cache
+        (the refs that keep it alive through the transfer window),
+        capture the host bytes, and resolve the row with the
+        `_HandoffPrefillDone` sentinel — the HTTP handler thread runs
+        the transfer, never this worker. Returns False (fall through to
+        local decode) when no target was named, the prompt spans less
+        than one full page, or the capture fails for any reason:
+        monolithic decode is always the graceful degradation."""
+        s = self._s
+        if not r.handoff_target or s.config.role != "prefill":
+            return False
+        kv = s._kv
+        st = r.step
+        t0 = _now()
+        try:
+            # chaos: a fault in the capture window degrades to local
+            # decode — the row must still complete, byte-identical
+            inject(
+                "serving.kv_export",
+                rid=r.request_id, row=r.row, phase="capture",
+            )
+            with s._lock:
+                kv.harvest([(r.tokens, r.kv_plan, int(st.pad), r.trace)])
+                payload = kv.export_prefix(r.tokens)
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            payload = None
+        if payload is None:
+            # a handoff-targeted request completing by local monolithic
+            # decode IS a fallback, whatever killed the capture
+            s._m_handoff_fallbacks.inc()
+            return False
+        from .handoff import payload_to_wire
+
+        r.handoff_payload = payload_to_wire(payload)
+        st.phase = "done"
+        if r.trace is not None:
+            r.trace.add(
+                "kv_export", start=t0, dur_s=_now() - t0, group=st.gid,
+                row=r.row, pages=len(payload.pages),
+            )
+        r.finish(error=_HandoffPrefillDone(first_i))
+        return True
 
     def lanes(self, rows: list) -> list[list]:
         """Plain rows share one compiled step program per sampling

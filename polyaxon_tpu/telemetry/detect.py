@@ -241,6 +241,19 @@ DEFAULT_SERVING_RULES: tuple[dict, ...] = (
         "window_s": 60.0,
         "threshold": 4.0,
     },
+    # disaggregated serving (ISSUE 20): handoff latency p95 doubling
+    # window-over-window means the prefill→decode transfer path is
+    # degrading (network, decode-pool headroom, or retry storms) — the
+    # first symptom before fallbacks start eating the decode pool's TTFT
+    # advantage
+    {
+        "name": "handoff-latency-trend",
+        "series": "serving.kv_handoff_ms",
+        "kind": "window_ratio",
+        "agg": "p95",
+        "window_s": 60.0,
+        "threshold": 2.0,
+    },
 )
 
 

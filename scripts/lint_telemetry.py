@@ -189,6 +189,21 @@ by the server layer on the telemetry clock. Any direct
 `time.time/monotonic/perf_counter/sleep` (and `_ns` variants) or
 `datetime.now/utcnow/today` call in those two files is forbidden.
 
+Seventeenth rule: NO raw clock in the KV handoff module. The
+prefill→decode transfer layer (`polyaxon_tpu/serving/handoff.py`) —
+lease table, wire codec, transfer client — is pure protocol state:
+epochs are logical integers, retry backoff sleeps ride
+`threading.Event.wait` on the shared `RetryPolicy` curve, and the only
+deadline is the per-attempt socket timeout. A raw `time.*()` /
+`datetime.now()` read there would couple lease outcomes and retry
+schedules to host timing: the seeded chaos replays (kill at export/
+import/adopt → zero leak, clean retry or clean fallback) and the
+stale-epoch rejection tests would stop reproducing. The handoff
+latency the operator sees (`serving_kv_handoff_ms`) is observed by the
+server layer on the telemetry clock. Any direct `time.time/monotonic/
+perf_counter/sleep` (and `_ns` variants) or `datetime.now/utcnow/
+today` call in that file is forbidden.
+
 Scope is the package only. Benchmarks, tests, and top-level scripts own
 their methodology (e.g. benchmarks/_timing.py subtracts tunnel RTT) and
 are exempt.
@@ -314,6 +329,16 @@ TENANCY_MODULES = (
     ("polyaxon_tpu", "serving", "tenancy.py"),
     ("polyaxon_tpu", "serving", "adapters.py"),
 )
+HANDOFF_PATTERN = re.compile(
+    r"\btime\.(?:time|monotonic|perf_counter|sleep)(?:_ns)?\s*\("
+    r"|\bdatetime\.(?:now|utcnow|today)\s*\("
+)
+#: the KV handoff layer is pure protocol state — logical epochs, Event-
+#: based backoff, socket-timeout deadlines — so seeded chaos replays
+#: reproduce (rule 17); the latency histogram is the server layer's
+HANDOFF_MODULES = (
+    ("polyaxon_tpu", "serving", "handoff.py"),
+)
 
 
 def violations(repo_root: Path) -> list[str]:
@@ -374,6 +399,7 @@ def violations(repo_root: Path) -> list[str]:
         in_scenarios = rel.parts[:2] == ("polyaxon_tpu", "scenarios")
         in_spill = rel.parts in SPILL_MODULES
         in_tenancy = rel.parts in TENANCY_MODULES
+        in_handoff = rel.parts in HANDOFF_MODULES
         for i, line in enumerate(py.read_text().splitlines(), 1):
             code = line.split("#", 1)[0]
             if PATTERN.search(code):
@@ -461,6 +487,14 @@ def violations(repo_root: Path) -> list[str]:
                     f"the registry orders recency by its logical seq; "
                     f"queue-wait timing belongs to the server layer: "
                     f"{line.strip()}"
+                )
+            if in_handoff and HANDOFF_PATTERN.search(code):
+                out.append(
+                    f"{rel}:{i}: raw clock in the KV handoff layer — "
+                    f"epochs are logical, backoff rides "
+                    f"threading.Event.wait, deadlines are socket "
+                    f"timeouts; handoff latency belongs to the server "
+                    f"layer: {line.strip()}"
                 )
     return out
 

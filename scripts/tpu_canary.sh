@@ -1798,6 +1798,148 @@ PY
       echo "TENANCY-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1
     fi
+    # handoff gate (ISSUE 20): a disaggregated prefill+decode pair behind
+    # the router. One request must complete over a REAL live KV handoff
+    # (export -> /kv_import -> adopt, zero fallbacks for it), then a
+    # decode-side crash injected mid-import and finally a hard decode
+    # kill must both complete via retry-or-fallback — zero failed
+    # requests, byte-identical tokens on all three paths — and the
+    # serving_kv_handoff_* series must be live on /metricsz. A handoff
+    # that silently falls back on the clean path, drops a request when
+    # the decode pool dies, or serves dark FAILS.
+    echo "running handoff smoke $(date -u +%T)" >> "$log"
+    if ! timeout 600 python - >> "$log" 2>&1 <<'PY'
+import json
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.chaos.injector import active
+from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+from polyaxon_tpu.models import build_model
+from polyaxon_tpu.serving.batching import ServingConfig
+from polyaxon_tpu.serving.router import P2CBalancer, Router, parse_prometheus
+from polyaxon_tpu.serving.server import ModelServer
+
+cfg = {"preset": "tiny", "seq_len": 128, "n_layers": 2, "dim": 64,
+       "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128}
+b = build_model("transformer_lm", cfg)
+params = b.module.init(
+    {"params": jax.random.PRNGKey(0)},
+    jnp.zeros((1, 8), jnp.int32), train=False,
+)["params"]
+
+
+def server(role):
+    return ModelServer(b.module, params, config=ServingConfig(
+        max_batch=2, max_wait_ms=10.0, kv_page_tokens=8, kv_pool_pages=64,
+        chunked_prefill=True, prefix_cache=True, role=role,
+    ))
+
+
+def post(port, rid):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"tokens": [list(range(1, 15))],
+                         "maxNewTokens": 8, "temperature": 0.0}).encode(),
+        headers={"Content-Type": "application/json", "X-Request-Id": rid},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return r.status, json.loads(r.read())
+
+
+pre, dec = server("prefill"), server("decode")
+pp, dp = pre.start(port=0), dec.start(port=0)
+router = Router([f"http://127.0.0.1:{pp}", f"http://127.0.0.1:{dp}"],
+                balancer=P2CBalancer(seed=7), poll_interval_s=0.1)
+rp = router.start("127.0.0.1", 0)
+try:
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        router.poll_once()
+        reps = router.stats()["replicas"]
+        if len(reps) == 2 and all(r["healthy"] for r in reps):
+            break
+        time.sleep(0.1)
+    else:
+        print("handoff smoke: pooled replicas never came healthy")
+        sys.exit(1)
+    # 1) clean path: a real export -> import -> adopt, no fallback
+    s1, p1 = post(rp, "canary-h1")
+    ho = pre.stats()["handoff"]
+    im = dec.stats()["handoff"]
+    if s1 != 200 or ho["exports"] < 1 or im["imports"] < 1:
+        print("handoff smoke: no live handoff on the clean path",
+              s1, ho, im)
+        sys.exit(1)
+    if ho["fallbacks"] != 0:
+        print("handoff smoke: clean path fell back monolithic", ho)
+        sys.exit(1)
+    # 2) decode-side crash mid-import: retry-or-fallback, never a 5xx
+    with active(FaultPlan([Fault("serving.kv_import", "raise", at=0)])):
+        s2, p2 = post(rp, "canary-h1")
+    # 3) hard decode kill: the pool is gone, the request still lands
+    dec_text = urllib.request.urlopen(
+        f"http://127.0.0.1:{dp}/metricsz", timeout=30).read().decode()
+    dec.stop()
+    s3, p3 = post(rp, "canary-h1")
+    if s2 != 200 or s3 != 200:
+        print("handoff smoke: request failed under decode loss", s2, s3)
+        sys.exit(1)
+    if not (p1["tokens"] == p2["tokens"] == p3["tokens"]):
+        print("handoff smoke: fallback paths diverged",
+              p1["tokens"], p2["tokens"], p3["tokens"])
+        sys.exit(1)
+    if pre.stats()["handoff"]["fallbacks"] < 1:
+        print("handoff smoke: injected import crash never counted a "
+              "fallback", pre.stats()["handoff"])
+        sys.exit(1)
+    # drain honesty: no leaked pages, no export stuck in flight
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        m = parse_prometheus(urllib.request.urlopen(
+            f"http://127.0.0.1:{pp}/metricsz", timeout=30).read().decode())
+        used = m.get("serving_kv_pages_used", 0.0)
+        held = m.get("serving_kv_pages_prefix_held", 0.0)
+        if used <= 1 + held and m.get("serving_kv_handoff_inflight") == 0:
+            break
+        time.sleep(0.1)
+    else:
+        print("handoff smoke: pages leaked or export stuck", m)
+        sys.exit(1)
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{pp}/metricsz", timeout=30).read().decode()
+finally:
+    router.stop()
+    pre.stop()
+    dec.stop()
+with open("tpu_results/handoff_metricsz_tpu.txt", "w") as f:
+    f.write(text + dec_text)
+required = (
+    "serving_kv_handoff_ms_bucket",
+    "serving_kv_handoff_exports_total",
+    "serving_kv_handoff_fallbacks_total",
+    "serving_kv_handoff_inflight",
+    "serving_kv_pages_handoff_held",
+)
+missing = [s for s in required if s not in text]
+if "serving_kv_handoff_imports_total" not in dec_text:
+    missing = list(missing) + ["serving_kv_handoff_imports_total (decode)"]
+if missing:
+    print("handoff smoke: MISSING series:", ", ".join(missing))
+    sys.exit(1)
+print(f"handoff smoke: ok ({len(required) + 1} required series present, "
+      f"{ho['exports']} exports / {im['imports']} imports clean, "
+      f"import-crash and decode-kill both completed byte-identically)")
+PY
+    then
+      echo "HANDOFF-SMOKE-FAILED $(date -u +%T); aborting capture" >> "$log"
+      exit 1
+    fi
     python scripts/lint_telemetry.py >> "$log" 2>&1 || {
       echo "TELEMETRY-LINT-FAILED $(date -u +%T); aborting capture" >> "$log"
       exit 1

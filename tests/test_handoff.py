@@ -1,0 +1,585 @@
+"""Disaggregated prefill/decode pools with crash-honest live KV handoff
+(ISSUE 20):
+
+  * LeaseTable units: monotonic epochs, same/lower-epoch rejection,
+    preemption (complete-after-preempt is disowned), release, the id
+    bound;
+  * wire codec: SpillPayload → CRC-framed bytes round-trip, torn and
+    corrupt inputs rejected whole;
+  * HandoffClient against scripted upstreams: connection-level failures
+    retry on the RetryPolicy curve with strictly increasing epochs,
+    protocol refusals (409/400/503) are final, exhaustion reports
+    "connect";
+  * router `_handoff_for` unit: only a prefill replica with a
+    decode-capable sibling gets a target;
+  * seeded chaos plans: `FaultPlan.kv_handoff_crash` determinism;
+  * live two-pool rig (prefill + decode replicas behind the router,
+    speculative decode on, vs a monolithic direct server): byte-identity
+    for greedy/sampled/streamed paths through a REAL export→import→adopt
+    handoff, mid-flight stream continuation, stale-exporter double-adopt
+    rejected over HTTP, and a chaos kill in every handoff window
+    (export-capture, export-send, import, adopt) — each completes the
+    request by clean retry or monolithic fallback with zero leaked pages
+    on either side.
+"""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.serving.handoff import (
+    HandoffClient,
+    HandoffError,
+    LeaseTable,
+    StaleLeaseError,
+    payload_from_wire,
+    payload_to_wire,
+)
+from polyaxon_tpu.serving.router import (
+    P2CBalancer,
+    ReplicaState,
+    Router,
+    parse_prometheus,
+)
+from polyaxon_tpu.serving.spill import SpillPayload
+
+pytestmark = pytest.mark.serving
+
+CFG = {
+    "preset": "tiny", "seq_len": 64, "n_layers": 2, "dim": 64,
+    "n_heads": 4, "n_kv_heads": 2, "vocab_size": 128,
+}
+
+
+# ------------------------------------------------------------ lease units
+def test_lease_table_monotonic_epochs():
+    t = LeaseTable()
+    lease = t.acquire("r1", 5)
+    assert t.active == 1
+    assert t.complete(lease) is True
+    assert t.active == 0
+    # the id remembers its high-water mark after completion
+    with pytest.raises(StaleLeaseError):
+        t.acquire("r1", 5)
+    with pytest.raises(StaleLeaseError):
+        t.acquire("r1", 4)
+    higher = t.acquire("r1", 6)
+    assert t.complete(higher) is True
+    st = t.stats()
+    assert st["granted"] == 2 and st["completed"] == 2
+    assert st["stale_rejections"] == 2
+
+
+def test_lease_table_preemption_disowns_the_loser():
+    t = LeaseTable()
+    old = t.acquire("r2", 1)
+    new = t.acquire("r2", 2)  # preempts mid-adopt
+    assert old.state == "preempted"
+    # the stale owner's completion is disowned — it must stand down
+    assert t.complete(old) is False
+    assert t.complete(new) is True
+    assert t.stats()["preempted"] == 1
+
+
+def test_lease_table_release_allows_higher_retry():
+    t = LeaseTable()
+    lease = t.acquire("r3", 7)
+    t.release(lease)  # abort path: shed mid-adopt
+    assert t.active == 0
+    # same epoch stays burned (monotonicity survives the abort)...
+    with pytest.raises(StaleLeaseError):
+        t.acquire("r3", 7)
+    # ...but the retry's higher epoch proceeds
+    assert t.acquire("r3", 8).epoch == 8
+
+
+def test_lease_table_id_bound_evicts_oldest():
+    t = LeaseTable(max_ids=2)
+    for i in range(3):
+        t.complete(t.acquire(f"id{i}", 1))
+    # id0 was forgotten by the bound: its epoch history reset
+    assert t.acquire("id0", 1).epoch == 1
+    with pytest.raises(StaleLeaseError):
+        t.acquire("id2", 1)
+
+
+# ------------------------------------------------------------- wire codec
+def _payload(n_pages=2, leaves=2):
+    pages = [
+        [np.full((2, 3), 10 * p + l, dtype=np.float32)
+         for l in range(leaves)]
+        for p in range(n_pages)
+    ]
+    tokens = tuple(range(8 * n_pages))
+    hashes = tuple(f"h{p}" for p in range(n_pages))
+    return SpillPayload(tokens, hashes, pages)
+
+
+def test_wire_roundtrip():
+    p = _payload()
+    data = payload_to_wire(p)
+    q = payload_from_wire(data)
+    assert q.tokens == p.tokens and q.hashes == p.hashes
+    assert len(q.pages) == len(p.pages)
+    for a, b in zip(p.pages, q.pages):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_wire_rejects_torn_and_corrupt():
+    data = payload_to_wire(_payload())
+    with pytest.raises(HandoffError, match="torn"):
+        payload_from_wire(data[:-7])  # truncated mid-frame
+    flipped = bytearray(data)
+    flipped[len(data) // 2] ^= 0xFF
+    with pytest.raises(HandoffError):
+        payload_from_wire(bytes(flipped))
+    # a structurally-clean but shape-less frame set is also refused
+    from polyaxon_tpu.store.eventlog import frame
+
+    with pytest.raises(HandoffError, match="malformed"):
+        payload_from_wire(frame(b'{"not": "a segment"}'))
+
+
+# ------------------------------------------------------- scripted client
+class _ScriptedImport(BaseHTTPRequestHandler):
+    """POST /kv_import upstream answering from a scripted status list."""
+
+    script: list = []
+    seen: list = []
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        type(self).seen.append((
+            self.headers.get("X-Handoff-Id"),
+            int(self.headers.get("X-Handoff-Epoch")),
+        ))
+        status, body = type(self).script.pop(0)
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):  # noqa: ARG002 — quiet test logs
+        pass
+
+
+def _scripted(script):
+    handler = type("H", (_ScriptedImport,), {"script": list(script),
+                                             "seen": []})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, handler, f"http://127.0.0.1:{srv.server_port}"
+
+
+def test_client_retries_502_then_adopts():
+    srv, handler, url = _scripted([
+        (502, {"error": "upstream sneeze"}),
+        (200, {"adopted_pages": 3}),
+    ])
+    try:
+        res = HandoffClient().send(url, "rid-a", b"x", base_epoch=2)
+        assert res.ok and res.adopted_pages == 3 and res.attempts == 2
+        # epochs strictly increase across attempts, offset by the
+        # router-attempt base so a failed-over exporter always outranks
+        assert [e for _, e in handler.seen] == [512, 513]
+        assert res.epoch == 513
+    finally:
+        srv.shutdown()
+
+
+def test_client_protocol_refusals_are_final():
+    for status, body, want in (
+        (409, {"reason": "stale_epoch"}, "stale_epoch"),
+        (400, {"error": "bad hash chain"}, "rejected"),
+        (503, {"reason": "kv_handoff"}, "kv_handoff"),
+    ):
+        srv, handler, url = _scripted([(status, body)])
+        try:
+            res = HandoffClient().send(url, "rid-b", b"x")
+            assert not res.ok and res.reason == want
+            assert res.attempts == 1  # refusals never burn retries
+            assert len(handler.seen) == 1
+        finally:
+            srv.shutdown()
+
+
+def test_client_connect_exhaustion():
+    from polyaxon_tpu.retry import RetryPolicy
+
+    # a dead port: every attempt is a connection-level failure
+    client = HandoffClient(
+        retry=RetryPolicy(max_retries=1, backoff=0.01, backoff_max=0.02),
+        attempt_timeout_s=0.5,
+    )
+    res = client.send("http://127.0.0.1:9", "rid-c", b"x")
+    assert not res.ok and res.reason == "connect" and res.attempts == 2
+
+
+# ---------------------------------------------------------- router units
+def _state(url, role="both"):
+    s = ReplicaState(url=url, slug=url[-2:], healthy=True)
+    s.role = role
+    return s
+
+
+def test_handoff_for_targets_decode_siblings_only():
+    r = Router([], balancer=P2CBalancer(seed=1))
+    pre = _state("http://p/r0", "prefill")
+    dec = _state("http://d/r1", "decode")
+    both = _state("http://b/r2", "both")
+    # prefill + decode sibling: target is the first non-prefill sink
+    assert r._handoff_for(pre, [pre, dec, both], 0) == ("http://d/r1", 0)
+    assert r._handoff_for(pre, [pre, both], 2) == ("http://b/r2", 2)
+    # a decode/both replica never gets a target
+    assert r._handoff_for(dec, [pre, dec], 0) is None
+    assert r._handoff_for(both, [both, dec], 0) is None
+    # a prefill-only fleet degrades to monolithic (no header)
+    assert r._handoff_for(pre, [pre], 0) is None
+    assert r._handoff_for(
+        pre, [pre, _state("http://q/r3", "prefill")], 0
+    ) is None
+
+
+def test_kv_handoff_crash_plan_is_seed_deterministic():
+    from polyaxon_tpu.chaos.plan import FaultPlan
+
+    a = FaultPlan.kv_handoff_crash(seed=5, window=4)
+    b = FaultPlan.kv_handoff_crash(seed=5, window=4)
+    assert a.params == b.params
+    assert [vars(f) for f in a.faults] == [vars(f) for f in b.faults]
+    assert a.params["fault_point"] in (
+        "serving.kv_export", "serving.kv_import", "serving.kv_adopt"
+    )
+    assert 0 <= a.params["fault_hit"] < 4
+    assert any(
+        FaultPlan.kv_handoff_crash(seed=s).params != a.params
+        for s in range(6, 16)
+    )
+
+
+# ------------------------------------------------------- live two-pool rig
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import build_model
+
+    b = build_model("transformer_lm", CFG)
+    params = b.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )["params"]
+    return b.module, params
+
+
+def _server(module, params, **overrides):
+    from polyaxon_tpu.serving.batching import ServingConfig
+    from polyaxon_tpu.serving.server import ModelServer
+
+    cfg = ServingConfig(**{
+        "max_batch": 4, "max_wait_ms": 2.0, "kv_page_tokens": 8,
+        "kv_pool_pages": 64, "stream_chunk_tokens": 3,
+        "chunked_prefill": True, "prefix_cache": True,
+        # the speculative path rides every request in this rig: identical
+        # configs on both sides keep byte-identity meaningful
+        "speculate": True, "draft_tokens": 3,
+        **overrides,
+    })
+    return ModelServer(module, params, model_name="tiny", config=cfg)
+
+
+@pytest.fixture(scope="module")
+def pools():
+    module, params = _build()
+    pre = _server(module, params, role="prefill")
+    dec = _server(module, params, role="decode")
+    direct = _server(module, params)
+    pp, dp, xp = pre.start(port=0), dec.start(port=0), direct.start(port=0)
+    router = Router(
+        [f"http://127.0.0.1:{pp}", f"http://127.0.0.1:{dp}"],
+        balancer=P2CBalancer(seed=7), poll_interval_s=0.1,
+    )
+    rp = router.start("127.0.0.1", 0)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        router.poll_once()
+        reps = router.stats()["replicas"]
+        if len(reps) == 2 and all(r["healthy"] for r in reps):
+            break
+        time.sleep(0.1)
+    yield {
+        "pre": pre, "dec": dec, "direct": direct, "router": router,
+        "pp": pp, "dp": dp, "xp": xp, "rp": rp,
+    }
+    router.stop()
+    pre.stop()
+    dec.stop()
+    direct.stop()
+
+
+def _post(port, body, path="/generate", rid=None, timeout=120):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    c.request("POST", path, body if isinstance(body, (bytes, str))
+              else json.dumps(body), headers)
+    r = c.getresponse()
+    out = r.read()
+    c.close()
+    return r.status, out
+
+
+def _get(port, path):
+    import urllib.request
+
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=60
+    ).read()
+
+
+def _stream_tokens(raw: bytes) -> dict[int, list[int]]:
+    rows: dict[int, list[int]] = {}
+    for line in raw.decode().splitlines():
+        if line.startswith("data: "):
+            ev = json.loads(line[6:])
+            if "tokens" in ev and "row" in ev:
+                rows.setdefault(ev["row"], []).extend(ev["tokens"])
+    return rows
+
+
+def _drained(port, budget_s=15.0):
+    """Zero-leak gate: used pages back to scratch + prefix-held, no
+    export in flight. Polls because restores/fallbacks finish async."""
+    deadline = time.monotonic() + budget_s
+    last = {}
+    while time.monotonic() < deadline:
+        last = parse_prometheus(_get(port, "/metricsz").decode())
+        used = last.get("serving_kv_pages_used", 0.0)
+        held = last.get("serving_kv_pages_prefix_held", 0.0)
+        inflight = last.get("serving_kv_handoff_inflight", 0.0)
+        if used <= 1 + held and inflight == 0:
+            return True
+        time.sleep(0.1)
+    raise AssertionError(f"pages leaked or export stuck: {last}")
+
+
+def _prompt(seed, n=14):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, CFG["vocab_size"] - 1, size=n).tolist()
+
+
+def test_pooled_byte_identity_nonstream(pools):
+    exports0 = pools["pre"].stats()["handoff"]["exports"]
+    bodies = [
+        {"tokens": [_prompt(1)], "maxNewTokens": 8},
+        {"tokens": [_prompt(2)], "maxNewTokens": 8, "temperature": 0.8,
+         "topK": 40, "seed": 123},
+    ]
+    for i, body in enumerate(bodies):
+        rid = f"rid-pool-{i}"
+        raw = json.dumps(body)
+        s1, o1 = _post(pools["xp"], raw, rid=rid)
+        s2, o2 = _post(pools["rp"], raw, rid=rid)
+        assert s1 == 200 and s2 == 200, (s1, s2, o1, o2)
+        assert o1 == o2  # bytes, not just tokens
+    # the identity rode REAL handoffs, not a silent fallback
+    h = pools["pre"].stats()["handoff"]
+    assert h["exports"] >= exports0 + 2, h
+    d = pools["dec"].stats()["handoff"]
+    assert d["imports"] >= 2 and d["leases"]["completed"] >= 2
+    _drained(pools["pp"])
+    _drained(pools["dp"])
+
+
+def test_pooled_stream_continues_midflight(pools):
+    body = {"tokens": [_prompt(3)], "maxNewTokens": 8, "temperature": 0.7,
+            "topK": 30, "seed": 99}
+    rid = "rid-pool-stream"
+    raw = json.dumps(body)
+    s1, o1 = _post(pools["xp"], raw, rid=rid)
+    s2, o2 = _post(pools["rp"], raw, path="/generate?stream=1", rid=rid)
+    assert s1 == 200 and s2 == 200, (o1, o2)
+    whole = json.loads(o1)["tokens"][0]
+    rows = _stream_tokens(o2)
+    # first token came from the prefill replica, the rest streamed from
+    # the decode replica mid-flight — trimmed to exactly the suffix
+    assert rows[0] == whole[len(body["tokens"][0]):]
+    frames = [json.loads(l[6:]) for l in o2.decode().splitlines()
+              if l.startswith("data: ")]
+    assert frames[-1].get("done") is True
+    assert not any("error" in f for f in frames)
+    _drained(pools["pp"])
+    _drained(pools["dp"])
+
+
+def test_role_advertised_on_surfaces(pools):
+    for port, role in ((pools["pp"], "prefill"), (pools["dp"], "decode"),
+                       (pools["xp"], "both")):
+        ready = json.loads(_get(port, "/readyz"))
+        assert ready["role"] == role
+        kvz = json.loads(_get(port, "/kvz"))
+        assert kvz["role"] == role
+    st = json.loads(_get(pools["rp"], "/statsz"))
+    assert {r["replica_role"] for r in st["replicas"]} == \
+        {"prefill", "decode"}
+    # the handoff series flow on /metricsz
+    pre_m = _get(pools["pp"], "/metricsz").decode()
+    assert "serving_kv_handoff_ms_bucket" in pre_m
+    assert "serving_kv_handoff_exports_total" in pre_m
+    dec_m = parse_prometheus(_get(pools["dp"], "/metricsz").decode())
+    assert dec_m.get("serving_kv_handoff_imports_total", 0.0) >= 1.0
+    # and the /statsz kv block counts adoption, not leakage
+    kv = pools["dec"].stats()["kv"]["handoff"]
+    assert kv["adopted_pages"] >= 1 and kv["pending_pages"] == 0
+
+
+def test_stale_exporter_double_adopt_rejected_over_http(pools):
+    # harvest a real page set on the prefill replica, then replay the
+    # SAME bytes with non-increasing epochs: a stale exporter that lost
+    # the router's failover race can never double-adopt
+    prompt = _prompt(4, n=16)
+    s, _ = _post(pools["pp"], {"tokens": [prompt], "maxNewTokens": 4})
+    assert s == 200
+    payload = pools["pre"]._kv.export_prefix(prompt)
+    assert payload is not None
+    data = payload_to_wire(payload)
+
+    def imp(epoch, rid="rid-stale"):
+        c = http.client.HTTPConnection("127.0.0.1", pools["dp"], timeout=60)
+        c.request("POST", "/kv_import", data, {
+            "Content-Type": "application/octet-stream",
+            "X-Handoff-Id": rid,
+            "X-Handoff-Epoch": str(epoch),
+        })
+        r = c.getresponse()
+        out = json.loads(r.read())
+        c.close()
+        return r.status, out
+
+    st0 = pools["dec"].stats()["handoff"]["leases"]["stale_rejections"]
+    code, body = imp(100)
+    assert code == 200 and body["adopted_pages"] >= 1
+    for stale in (100, 99):
+        code, body = imp(stale)
+        assert code == 409 and body["reason"] == "stale_epoch", body
+    # a higher epoch is honored — and idempotent (chain already resident)
+    code, body = imp(101)
+    assert code == 200 and body["adopted_pages"] == 0
+    assert pools["dec"].stats()["handoff"]["leases"]["stale_rejections"] \
+        == st0 + 2
+    # corrupt bytes never adopt
+    c = http.client.HTTPConnection("127.0.0.1", pools["dp"], timeout=60)
+    c.request("POST", "/kv_import", data[:-9], {
+        "Content-Type": "application/octet-stream",
+        "X-Handoff-Id": "rid-torn", "X-Handoff-Epoch": "1",
+    })
+    r = c.getresponse()
+    assert r.status == 400 and json.loads(r.read())["reason"] == "rejected"
+    c.close()
+    _drained(pools["dp"])
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point,at", [
+    ("serving.kv_export", 0),   # capture window: harvest/export dies
+    ("serving.kv_import", 0),   # import window: decode side 500s
+    ("serving.kv_adopt", 0),    # adopt window: dies holding fresh pages
+])
+def test_chaos_kill_in_handoff_window_falls_back_clean(pools, point, at):
+    from polyaxon_tpu.chaos.injector import active
+    from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+
+    body = {"tokens": [_prompt(50 + at * 7 + len(point))],
+            "maxNewTokens": 6}
+    rid = f"rid-chaos-{point.split('.')[-1]}"
+    raw = json.dumps(body)
+    s1, o1 = _post(pools["xp"], raw, rid=rid)
+    assert s1 == 200
+    fb0 = pools["pre"].stats()["handoff"]["fallbacks"]
+    plan = FaultPlan(
+        [Fault(point, "raise", at=at,
+               message=f"chaos: killed in {point} window")], seed=1,
+    )
+    with active(plan):
+        s2, o2 = _post(pools["rp"], raw, rid=rid)
+    # the client NEVER sees the crash: completed via monolithic fallback,
+    # byte-identical to the direct server
+    assert s2 == 200, o2
+    assert o1 == o2
+    assert pools["pre"].stats()["handoff"]["fallbacks"] == fb0 + 1
+    # zero leaked pages on either side, no export stuck in flight
+    _drained(pools["pp"])
+    _drained(pools["dp"])
+
+
+@pytest.mark.chaos
+def test_chaos_send_crash_retries_then_adopts(pools):
+    from polyaxon_tpu.chaos.injector import active
+    from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+
+    body = {"tokens": [_prompt(77)], "maxNewTokens": 6}
+    rid = "rid-chaos-send"
+    raw = json.dumps(body)
+    s1, o1 = _post(pools["xp"], raw, rid=rid)
+    assert s1 == 200
+    before = pools["pre"].stats()["handoff"]
+    granted0 = pools["dec"].stats()["handoff"]["leases"]["granted"]
+    # hit 0 is the capture window; hit 1 is send attempt 0 — the retry
+    # (attempt 1, next epoch) goes through: a CLEAN RETRY, not a fallback
+    plan = FaultPlan(
+        [Fault("serving.kv_export", "raise", at=1,
+               message="chaos: exporter died mid-send")], seed=2,
+    )
+    with active(plan):
+        s2, o2 = _post(pools["rp"], raw, rid=rid)
+    assert s2 == 200 and o1 == o2
+    after = pools["pre"].stats()["handoff"]
+    assert after["exports"] == before["exports"] + 1
+    assert after["fallbacks"] == before["fallbacks"]
+    assert pools["dec"].stats()["handoff"]["leases"]["granted"] == \
+        granted0 + 1
+    _drained(pools["pp"])
+    _drained(pools["dp"])
+
+
+@pytest.mark.chaos
+def test_chaos_import_crash_midstream_falls_back(pools):
+    from polyaxon_tpu.chaos.injector import active
+    from polyaxon_tpu.chaos.plan import Fault, FaultPlan
+
+    body = {"tokens": [_prompt(88)], "maxNewTokens": 8,
+            "temperature": 0.9, "topK": 25, "seed": 7}
+    rid = "rid-chaos-stream"
+    raw = json.dumps(body)
+    s1, o1 = _post(pools["xp"], raw, rid=rid)
+    assert s1 == 200
+    whole = json.loads(o1)["tokens"][0]
+    plan = FaultPlan(
+        [Fault("serving.kv_import", "raise", at=0,
+               message="chaos: import window death")], seed=3,
+    )
+    with active(plan):
+        s2, o2 = _post(pools["rp"], raw, path="/generate?stream=1",
+                       rid=rid)
+    assert s2 == 200
+    rows = _stream_tokens(o2)
+    # the stream resolved through the LOCAL fallback decode mid-flight:
+    # same bytes, no client-visible error, done frame present
+    assert rows[0] == whole[len(body["tokens"][0]):]
+    frames = [json.loads(l[6:]) for l in o2.decode().splitlines()
+              if l.startswith("data: ")]
+    assert frames[-1].get("done") is True
+    assert not any("error" in f for f in frames)
+    _drained(pools["pp"])
+    _drained(pools["dp"])

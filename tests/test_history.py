@@ -578,6 +578,7 @@ def test_build_rules_validation():
     assert [r.name for r in rules] == [
         "ttft-creep", "queue-wait-trend", "accept-rate-collapse",
         "kv-spill-surge", "tenant-queue-wait-trend", "adapter-thrash-surge",
+        "handoff-latency-trend",
     ]
     with pytest.raises(ValueError, match="duplicate"):
         build_rules(
